@@ -1,0 +1,33 @@
+"""gigapaxos_tpu — a TPU-native framework for very large numbers of small
+Replicated State Machines (paxos groups).
+
+Capability parity target: rchiesse/gigapaxos (a fork of
+MobilityFirst/gigapaxos, UMass Amherst) — a pure-Java framework for running
+millions of paxos groups per node with online reconfiguration.  This rebuild
+is **not a port**: the consensus data plane (the analog of the reference's
+``gigapaxos/PaxosAcceptor.java`` and ``gigapaxos/PaxosCoordinator.java`` hot
+loops) is a *columnar* SIMD kernel on TPU — acceptor/coordinator state for
+all groups lives in ``[G, W]`` JAX device arrays and prepare/accept/decide
+run as vmapped compares and popcount quorum checks — while the host control
+plane (transport, durable log, app callbacks, reconfiguration) mirrors the
+reference's layer map (SURVEY.md §1).
+
+Package layout:
+
+- ``utils``     — L0: enum-keyed config, delay profiler, logging
+                  (ref: ``src/edu/umass/cs/utils/``)
+- ``ops``       — the columnar consensus kernels (ref: ``gigapaxos/
+                  PaxosAcceptor.java``, ``PaxosCoordinator.java``, redesigned
+                  as JAX/XLA batched ops)
+- ``parallel``  — device mesh + shardings for the group axis (no analog in
+                  the reference; TPU-native scaling of the ``G`` dimension)
+- ``net``       — L1: asyncio TCP transport with framing, demux,
+                  backpressure, TLS (ref: ``src/edu/umass/cs/nio/``)
+- ``paxos``     — L2/L3: PaxosManager analog, packets, WAL logger,
+                  AcceptorBackend SPI (ref: ``src/edu/umass/cs/gigapaxos/``)
+- ``reconfiguration`` — L4: control plane (ref: ``src/edu/umass/cs/
+                  reconfiguration/``)
+- ``models``    — L6: example Replicable apps (ref: ``gigapaxos/examples/``)
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,512 @@
+"""Batched columnar paxos kernels.
+
+Each kernel is a pure function ``(state, batch arrays...) -> (state, outs)``
+over the whole-fleet :class:`~gigapaxos_tpu.ops.types.ColumnarState`.  A
+*batch* is a struct-of-arrays of B packet lanes; lanes with ``valid=False``
+are padding and must not mutate state (implemented by redirecting their
+scatter indices out of bounds and using ``mode="drop"``).
+
+Reference analogs (see SURVEY.md §3.1 hot path):
+
+- ``accept_batch``        <- ``PaxosAcceptor.acceptAndUpdateBallot`` (HOT #1)
+- ``accept_reply_batch``  <- ``PaxosCoordinator.handleAcceptReply`` majority
+                             counting (HOT #2)
+- ``propose_batch``       <- ``PaxosCoordinator.propose`` slot assignment
+- ``commit_batch``        <- decision handling feeding
+                             ``PaxosInstanceStateMachine.
+                             extractExecuteAndCheckpoint`` (HOT #3 stays
+                             host-side behind the Replicable boundary; this
+                             kernel maintains the device window frontier)
+- ``prepare_batch``       <- ``PaxosAcceptor.handlePrepare``
+- ``install_coordinator_batch`` <- phase-1 completion / pvalue carryover
+                             (``PaxosCoordinator`` run-for-coordinator);
+                             the *merge* of prepare replies is host-side
+                             (cold path), the window gathers are device-side
+
+Determinism note: a batch is applied as ONE linearization: per-group ballot
+promises take the max over the batch, so a lane whose ballot is below the
+batch max for its group is rejected even if it "arrived first".  Any such
+linearization is safe for paxos (rejection only affects liveness, and the
+host retries).
+
+Intra-batch preconditions (enforced by the host batcher,
+``gigapaxos_tpu.paxos.batcher``):
+
+- at most one accept lane per (group, slot) per batch (duplicates coalesced
+  to the max ballot) — mirrors ``PaxosPacketBatcher`` coalescing;
+- at most one accept-reply lane per (group, slot, sender) per batch, which
+  makes scatter-add equivalent to scatter-OR on the vote bitmaps.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from gigapaxos_tpu.ops.types import ColumnarState, NO_BALLOT, NO_SLOT
+
+i32 = jnp.int32
+u32 = jnp.uint32
+
+
+def _majority(members):
+    return members // 2 + 1
+
+
+def _gi(g, valid):
+    """Gather index: lane-0 row for invalid lanes (result unused)."""
+    return jnp.where(valid, g, 0)
+
+
+def _si(g, valid, G):
+    """Scatter index: out-of-bounds for invalid lanes (mode='drop')."""
+    return jnp.where(valid, g, G)
+
+
+# --------------------------------------------------------------------------
+# accept (acceptor side)                                  ref: PaxosAcceptor
+# --------------------------------------------------------------------------
+
+
+class AcceptOut(NamedTuple):
+    acked: jnp.ndarray        # bool[B] pvalue stored (or stale-decided)
+    stale: jnp.ndarray        # bool[B] slot < exec_cursor (already decided)
+    out_window: jnp.ndarray   # bool[B] beyond window: host must requeue
+    cur_bal: jnp.ndarray      # i32[B]  promised ballot after this batch
+
+
+def accept_batch(state: ColumnarState, g, slot, bal, rlo, rhi, valid):
+    G, W = state.G, state.W
+    si = _si(g, valid, G)
+    gi = _gi(g, valid)
+
+    item_bal = jnp.where(valid, bal, NO_BALLOT)
+    new_bal = state.bal.at[si].max(item_bal, mode="drop")
+    cur_bal = new_bal[gi]
+    act = state.active[gi]
+
+    promised_ok = valid & act & (bal >= cur_bal)
+    cursor = state.exec_cursor[gi]
+    stale = valid & act & (slot < cursor)
+    in_win = (slot >= cursor) & (slot < cursor + W)
+    store = promised_ok & in_win
+
+    w = jnp.where(store, slot % W, 0)
+    sgw = _si(g, store, G)
+    acc_bal = state.acc_bal.at[sgw, w].set(bal, mode="drop")
+    acc_slot = state.acc_slot.at[sgw, w].set(slot, mode="drop")
+    acc_req_lo = state.acc_req_lo.at[sgw, w].set(rlo, mode="drop")
+    acc_req_hi = state.acc_req_hi.at[sgw, w].set(rhi, mode="drop")
+
+    out = AcceptOut(
+        acked=store | (promised_ok & stale),
+        stale=stale,
+        out_window=promised_ok & ~in_win & ~stale,
+        cur_bal=cur_bal,
+    )
+    state = state._replace(
+        bal=new_bal, acc_bal=acc_bal, acc_slot=acc_slot,
+        acc_req_lo=acc_req_lo, acc_req_hi=acc_req_hi,
+    )
+    return state, out
+
+
+# --------------------------------------------------------------------------
+# accept-reply (coordinator side)            ref: PaxosCoordinator majority
+# --------------------------------------------------------------------------
+
+
+class AcceptReplyOut(NamedTuple):
+    newly_decided: jnp.ndarray  # bool[B] quorum crossed: emit a commit
+    preempted: jnp.ndarray      # bool[B] coordinator resigned (higher bal)
+    dec_slot: jnp.ndarray       # i32[B]  slot of the decision
+    dec_bal: jnp.ndarray        # i32[B]  coordinator ballot of the decision
+    req_lo: jnp.ndarray         # i32[B]  request id of the decided pvalue
+    req_hi: jnp.ndarray
+
+
+def accept_reply_batch(state: ColumnarState, g, slot, bal, sender, acked,
+                       valid):
+    """Handle (batched) accept replies.
+
+    ``bal`` carries the accepted ballot on ack lanes and the acceptor's
+    (higher) promised ballot on nack lanes, matching the reference's
+    ``AcceptReplyPacket`` semantics.
+    """
+    G, W = state.G, state.W
+    gi = _gi(g, valid)
+    w = jnp.where(valid, slot % W, 0)
+
+    coord_here = state.is_coord[gi] & state.coord_active[gi]
+    is_rel = valid & coord_here & (bal == state.cbal[gi])
+    # slot >= 0 guards against matching uninitialized vote columns
+    # (vote_slot inits to NO_SLOT = -1)
+    match = is_rel & acked & (slot >= 0) & (state.vote_slot[gi, w] == slot)
+
+    sender_u = sender.astype(u32)
+    bit = jnp.left_shift(u32(1), sender_u)
+    prev = state.votes[gi, w]
+    fresh = match & (jnp.bitwise_and(jnp.right_shift(prev, sender_u),
+                                     u32(1)) == 0)
+    sgw = _si(g, fresh, G)
+    votes = state.votes.at[sgw, w].add(jnp.where(fresh, bit, u32(0)),
+                                       mode="drop")
+
+    newv = votes[gi, w]
+    cnt = jax.lax.population_count(newv).astype(i32)
+    quorum = match & (cnt >= _majority(state.members[gi]))
+    # Exactly-once emission: besides the cross-batch `emitted` flag, dedupe
+    # WITHIN the batch — when two replies for the same (group, slot) cross
+    # quorum in one batch, only the first lane emits the decision.
+    same = (g[None, :] == g[:, None]) & (slot[None, :] == slot[:, None]) & \
+        quorum[None, :] & quorum[:, None]
+    lower = jnp.tril(jnp.ones((g.shape[0],) * 2, jnp.bool_), k=-1)
+    dup_before = jnp.any(same & lower, axis=1)
+    newly = quorum & ~state.emitted[gi, w] & ~dup_before
+    emitted = state.emitted.at[_si(g, newly, G), w].set(True, mode="drop")
+
+    # Preemption: a nack carrying a ballot above ours ends our reign
+    # (ref: PaxosCoordinator preemption on higher-ballot accept replies).
+    pre = valid & state.is_coord[gi] & ~acked & (bal > state.cbal[gi])
+    sp = _si(g, pre, G)
+    is_coord = state.is_coord.at[sp].set(False, mode="drop")
+    coord_active = state.coord_active.at[sp].set(False, mode="drop")
+
+    out = AcceptReplyOut(
+        newly_decided=newly,
+        preempted=pre,
+        dec_slot=slot,
+        dec_bal=state.cbal[gi],
+        req_lo=state.prop_req_lo[gi, w],
+        req_hi=state.prop_req_hi[gi, w],
+    )
+    state = state._replace(votes=votes, emitted=emitted, is_coord=is_coord,
+                           coord_active=coord_active)
+    return state, out
+
+
+# --------------------------------------------------------------------------
+# propose (coordinator slot assignment)         ref: PaxosCoordinator.propose
+# --------------------------------------------------------------------------
+
+
+class ProposeOut(NamedTuple):
+    granted: jnp.ndarray   # bool[B] slot assigned; emit AcceptPackets
+    rejected: jnp.ndarray  # bool[B] not coordinator here (host forwards)
+    throttled: jnp.ndarray  # bool[B] window full: host requeues
+    slot: jnp.ndarray      # i32[B]  assigned slot
+    cbal: jnp.ndarray      # i32[B]  coordinator ballot for the accept
+
+
+def propose_batch(state: ColumnarState, g, rlo, rhi, valid):
+    """Assign contiguous slots to new requests, multiple per group per batch.
+
+    Lane i's slot is ``next_slot[g] + rank_i`` where rank is the lane's
+    occurrence index among same-group lanes (an O(B^2) bool reduction —
+    fine for B ≤ a few thousand on the MXU; replace with a sort-based rank
+    if B grows).
+    """
+    G, W = state.G, state.W
+    B = g.shape[0]
+    gi = _gi(g, valid)
+
+    can = valid & state.active[gi] & state.is_coord[gi] & \
+        state.coord_active[gi]
+
+    same = (g[None, :] == g[:, None]) & can[None, :] & can[:, None]
+    lower = jnp.tril(jnp.ones((B, B), jnp.bool_), k=-1)
+    rank = jnp.sum(same & lower, axis=1).astype(i32)
+
+    slot = state.next_slot[gi] + rank
+    in_win = slot < state.exec_cursor[gi] + W
+    granted = can & in_win
+
+    # advance next_slot by per-group granted count
+    sg = _si(g, granted, G)
+    next_slot = state.next_slot.at[sg].add(jnp.where(granted, 1, 0),
+                                           mode="drop")
+
+    # initialize the vote column for the assigned slot
+    w = jnp.where(granted, slot % W, 0)
+    sgw = _si(g, granted, G)
+    votes = state.votes.at[sgw, w].set(u32(0), mode="drop")
+    vote_slot = state.vote_slot.at[sgw, w].set(slot, mode="drop")
+    prop_req_lo = state.prop_req_lo.at[sgw, w].set(rlo, mode="drop")
+    prop_req_hi = state.prop_req_hi.at[sgw, w].set(rhi, mode="drop")
+    emitted = state.emitted.at[sgw, w].set(False, mode="drop")
+
+    out = ProposeOut(
+        granted=granted,
+        rejected=valid & state.active[gi] & ~(state.is_coord[gi] &
+                                             state.coord_active[gi]),
+        throttled=can & ~in_win,
+        slot=slot,
+        cbal=state.cbal[gi],
+    )
+    state = state._replace(next_slot=next_slot, votes=votes,
+                           vote_slot=vote_slot, prop_req_lo=prop_req_lo,
+                           prop_req_hi=prop_req_hi, emitted=emitted)
+    return state, out
+
+
+# --------------------------------------------------------------------------
+# commit / decision                        ref: decision handling + window GC
+# --------------------------------------------------------------------------
+
+
+class CommitOut(NamedTuple):
+    applied: jnp.ndarray     # bool[B] decision recorded
+    stale: jnp.ndarray       # bool[B] already below exec_cursor
+    out_window: jnp.ndarray  # bool[B] host must requeue until window moves
+    new_cursor: jnp.ndarray  # i32[B]  group frontier after this batch
+
+
+def commit_batch(state: ColumnarState, g, slot, rlo, rhi, valid):
+    G, W = state.G, state.W
+    gi = _gi(g, valid)
+    act = state.active[gi]
+    cursor = state.exec_cursor[gi]
+
+    stale = valid & act & (slot < cursor)
+    in_win = (slot >= cursor) & (slot < cursor + W)
+    store = valid & act & in_win
+    w = jnp.where(store, slot % W, 0)
+    sgw = _si(g, store, G)
+
+    dec = state.dec.at[sgw, w].set(True, mode="drop")
+    dec_slot = state.dec_slot.at[sgw, w].set(slot, mode="drop")
+    dec_req_lo = state.dec_req_lo.at[sgw, w].set(rlo, mode="drop")
+    dec_req_hi = state.dec_req_hi.at[sgw, w].set(rhi, mode="drop")
+
+    # contiguity advance over the touched rows only ([B, W] gathers)
+    decr = dec[gi]
+    dslotr = dec_slot[gi]
+    k = jnp.arange(W, dtype=i32)[None, :]
+    want = cursor[:, None] + k
+    col = want % W
+    ok = jnp.take_along_axis(decr, col, axis=1) & \
+        (jnp.take_along_axis(dslotr, col, axis=1) == want)
+    adv = jnp.sum(jnp.cumprod(ok.astype(i32), axis=1), axis=1)
+    new_cur = cursor + adv
+
+    sg = _si(g, store, G)
+    exec_cursor = state.exec_cursor.at[sg].max(new_cur, mode="drop")
+
+    out = CommitOut(
+        applied=store,
+        stale=stale,
+        out_window=valid & act & (slot >= cursor + W),
+        new_cursor=exec_cursor[gi],
+    )
+    state = state._replace(dec=dec, dec_slot=dec_slot,
+                           dec_req_lo=dec_req_lo, dec_req_hi=dec_req_hi,
+                           exec_cursor=exec_cursor)
+    return state, out
+
+
+# --------------------------------------------------------------------------
+# prepare (acceptor side)                    ref: PaxosAcceptor.handlePrepare
+# --------------------------------------------------------------------------
+
+
+class PrepareOut(NamedTuple):
+    acked: jnp.ndarray        # bool[B]
+    cur_bal: jnp.ndarray      # i32[B] promise after batch (nack carries it)
+    exec_cursor: jnp.ndarray  # i32[B]
+    win_slot: jnp.ndarray     # i32[B,W] accepted-pvalue window (dense rows)
+    win_bal: jnp.ndarray      # i32[B,W]
+    win_req_lo: jnp.ndarray   # i32[B,W]
+    win_req_hi: jnp.ndarray   # i32[B,W]
+
+
+def prepare_batch(state: ColumnarState, g, bal, valid):
+    """Phase-1 prepare: promise update + dense gather of the accepted
+    window (the reference's PrepareReply carries all accepted pvalues ≥
+    firstUndecidedSlot; here that is exactly the row slice — SURVEY §7.3.4).
+    """
+    G, W = state.G, state.W
+    si = _si(g, valid, G)
+    gi = _gi(g, valid)
+
+    item_bal = jnp.where(valid, bal, NO_BALLOT)
+    new_bal = state.bal.at[si].max(item_bal, mode="drop")
+    cur_bal = new_bal[gi]
+    acked = valid & state.active[gi] & (bal >= cur_bal)
+
+    out = PrepareOut(
+        acked=acked,
+        cur_bal=cur_bal,
+        exec_cursor=state.exec_cursor[gi],
+        win_slot=state.acc_slot[gi],
+        win_bal=state.acc_bal[gi],
+        win_req_lo=state.acc_req_lo[gi],
+        win_req_hi=state.acc_req_hi[gi],
+    )
+    return state._replace(bal=new_bal), out
+
+
+# --------------------------------------------------------------------------
+# coordinator install (phase-1 completion + carryover)
+# --------------------------------------------------------------------------
+
+
+def install_coordinator_batch(state: ColumnarState, g, cbal, next_slot,
+                              carry_slot, carry_rlo, carry_rhi, valid):
+    """Install this node as active coordinator for groups ``g`` at ballot
+    ``cbal`` after a host-side phase-1 majority + pvalue merge.
+
+    ``carry_slot/carry_rlo/carry_rhi`` are ``[B, W]`` carryover pvalues to
+    re-propose (columns with ``carry_slot == -1`` are empty).  The host then
+    sends the corresponding AcceptPackets at the new ballot; votes columns
+    are initialized here.
+    """
+    G, W = state.G, state.W
+    si = _si(g, valid, G)
+    gi = _gi(g, valid)
+
+    is_coord = state.is_coord.at[si].set(True, mode="drop")
+    coord_active = state.coord_active.at[si].set(True, mode="drop")
+    cbal_arr = state.cbal.at[si].set(cbal, mode="drop")
+    ns = state.next_slot.at[si].set(next_slot, mode="drop")
+
+    has = valid[:, None] & (carry_slot >= 0)
+    w = jnp.where(has, carry_slot % W, 0)
+    sg = jnp.where(has, g[:, None], G)
+    votes = state.votes.at[sg, w].set(u32(0), mode="drop")
+    vote_slot = state.vote_slot.at[sg, w].set(carry_slot, mode="drop")
+    prop_req_lo = state.prop_req_lo.at[sg, w].set(carry_rlo, mode="drop")
+    prop_req_hi = state.prop_req_hi.at[sg, w].set(carry_rhi, mode="drop")
+    emitted = state.emitted.at[sg, w].set(False, mode="drop")
+
+    state = state._replace(
+        is_coord=is_coord, coord_active=coord_active, cbal=cbal_arr,
+        next_slot=ns, votes=votes, vote_slot=vote_slot,
+        prop_req_lo=prop_req_lo, prop_req_hi=prop_req_hi, emitted=emitted,
+    )
+    return state, None
+
+
+# --------------------------------------------------------------------------
+# group lifecycle                     ref: PaxosManager.createPaxosInstance
+# --------------------------------------------------------------------------
+
+
+def create_groups_batch(state: ColumnarState, rows, members, version,
+                        init_bal, self_coord, valid):
+    """(Re)initialize rows for newly created groups.
+
+    ``init_bal`` is the packed initial ballot ``(0, firstCoordinator)`` —
+    every replica starts promised to the deterministic initial coordinator,
+    which therefore safely skips phase 1 (no prior accepts can exist),
+    mirroring the reference's default-coordinator fast path.
+    ``self_coord`` marks rows where THIS node is that initial coordinator.
+    """
+    G, W = state.G, state.W
+    si = _si(rows, valid, G)
+    vT = valid
+    zW = jnp.zeros((rows.shape[0], W), i32)
+    nW = jnp.full((rows.shape[0], W), NO_SLOT, i32)
+    bW = jnp.full((rows.shape[0], W), NO_BALLOT, i32)
+    fW = jnp.zeros((rows.shape[0], W), jnp.bool_)
+
+    state = state._replace(
+        active=state.active.at[si].set(True, mode="drop"),
+        members=state.members.at[si].set(members, mode="drop"),
+        version=state.version.at[si].set(version, mode="drop"),
+        bal=state.bal.at[si].set(init_bal, mode="drop"),
+        acc_bal=state.acc_bal.at[si].set(bW, mode="drop"),
+        acc_slot=state.acc_slot.at[si].set(nW, mode="drop"),
+        acc_req_lo=state.acc_req_lo.at[si].set(zW, mode="drop"),
+        acc_req_hi=state.acc_req_hi.at[si].set(zW, mode="drop"),
+        dec=state.dec.at[si].set(fW, mode="drop"),
+        dec_slot=state.dec_slot.at[si].set(nW, mode="drop"),
+        dec_req_lo=state.dec_req_lo.at[si].set(zW, mode="drop"),
+        dec_req_hi=state.dec_req_hi.at[si].set(zW, mode="drop"),
+        exec_cursor=state.exec_cursor.at[si].set(0, mode="drop"),
+        gc_slot=state.gc_slot.at[si].set(NO_SLOT, mode="drop"),
+        is_coord=state.is_coord.at[si].set(vT & self_coord, mode="drop"),
+        coord_active=state.coord_active.at[si].set(vT & self_coord,
+                                                   mode="drop"),
+        cbal=state.cbal.at[si].set(jnp.where(self_coord, init_bal,
+                                             NO_BALLOT), mode="drop"),
+        next_slot=state.next_slot.at[si].set(0, mode="drop"),
+        prep_votes=state.prep_votes.at[si].set(u32(0), mode="drop"),
+        votes=state.votes.at[si].set(jnp.zeros_like(zW, u32), mode="drop"),
+        vote_slot=state.vote_slot.at[si].set(nW, mode="drop"),
+        prop_req_lo=state.prop_req_lo.at[si].set(zW, mode="drop"),
+        prop_req_hi=state.prop_req_hi.at[si].set(zW, mode="drop"),
+        emitted=state.emitted.at[si].set(fW, mode="drop"),
+    )
+    return state, None
+
+
+def delete_groups_batch(state: ColumnarState, rows, valid):
+    G = state.G
+    si = _si(rows, valid, G)
+    state = state._replace(
+        active=state.active.at[si].set(False, mode="drop"),
+        is_coord=state.is_coord.at[si].set(False, mode="drop"),
+        coord_active=state.coord_active.at[si].set(False, mode="drop"),
+    )
+    return state, None
+
+
+def set_cursor_batch(state: ColumnarState, rows, cursor, next_slot, valid):
+    """Restore execution frontier on recovery/unpause (host is authoritative
+    for executed state; ref: hot-restore via HotRestoreInfo)."""
+    G = state.G
+    si = _si(rows, valid, G)
+    state = state._replace(
+        exec_cursor=state.exec_cursor.at[si].set(cursor, mode="drop"),
+        next_slot=state.next_slot.at[si].max(next_slot, mode="drop"),
+    )
+    return state, None
+
+
+def gc_batch(state: ColumnarState, rows, upto, valid):
+    """Record checkpoint slot (log below it is GC-eligible host-side)."""
+    G = state.G
+    si = _si(rows, valid, G)
+    state = state._replace(
+        gc_slot=state.gc_slot.at[si].max(upto, mode="drop"))
+    return state, None
+
+
+# --------------------------------------------------------------------------
+# row export/import (pause/unpause, debugging)       ref: HotRestoreInfo
+# --------------------------------------------------------------------------
+
+
+def gather_rows(state: ColumnarState, rows):
+    """Pull full per-row state for ``rows`` to a pytree of [B,...] arrays."""
+    return jax.tree_util.tree_map(lambda a: a[rows], state)
+
+
+def scatter_rows(state: ColumnarState, rows, row_state: ColumnarState,
+                 valid):
+    """Write previously gathered rows back (unpause)."""
+    G = state.G
+    si = _si(rows, valid, G)
+    return jax.tree_util.tree_map(
+        lambda a, r: a.at[si].set(r, mode="drop"), state, row_state), None
+
+
+# --------------------------------------------------------------------------
+# jit entry points
+# --------------------------------------------------------------------------
+
+# State buffers are donated: each call consumes the old state arrays and
+# reuses them in-place (XLA aliasing), which is what keeps 1M-group state
+# resident with zero copies per batch.
+accept = jax.jit(accept_batch, donate_argnums=0)
+accept_reply = jax.jit(accept_reply_batch, donate_argnums=0)
+propose = jax.jit(propose_batch, donate_argnums=0)
+commit = jax.jit(commit_batch, donate_argnums=0)
+prepare = jax.jit(prepare_batch, donate_argnums=0)
+install_coordinator = jax.jit(install_coordinator_batch, donate_argnums=0)
+create_groups = jax.jit(create_groups_batch, donate_argnums=0)
+delete_groups = jax.jit(delete_groups_batch, donate_argnums=0)
+set_cursor = jax.jit(set_cursor_batch, donate_argnums=0)
+gc = jax.jit(gc_batch, donate_argnums=0)

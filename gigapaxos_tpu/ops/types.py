@@ -1,0 +1,169 @@
+"""Columnar paxos state: one row per group, slot window of width W.
+
+Reference analog: the fields of ``gigapaxos/PaxosAcceptor.java`` (ballot,
+slot, accepted-pvalues map, GC slot) and ``gigapaxos/
+PaxosCoordinatorState.java`` (coordinator ballot, next slot, waiting-for-
+majority maps), flattened from one-heap-object-per-group into
+struct-of-arrays device buffers.
+
+Design notes (TPU-first):
+
+- **Packed ballots.** A paxos ballot is the lexicographic pair
+  ``(ballotNumber, coordinatorID)`` (ref: ``gigapaxos/paxosutil/
+  Ballot.java``).  We pack it into one int32 — ``num << NODE_BITS | coord``
+  — so ballot comparison is a single integer compare, which vectorizes
+  trivially.  ``NODE_BITS=12`` allows 4096 node ids and ~2^19 ballot
+  numbers per group (a ballot number increments only on coordinator
+  changes).  ``NO_BALLOT = -1`` sorts below every real ballot.
+
+- **Slot window.** Each group stores a circular window of W slots; slot
+  ``s`` lives in column ``s % W``.  A slot is admissible while
+  ``exec_cursor <= s < exec_cursor + W``.  This bounds per-group device
+  memory exactly like the reference bounds it with checkpoint-interval log
+  GC (ref: ``PaxosConfig PC.CHECKPOINT_INTERVAL`` ~400 slots; here W is
+  the analogous knob, and the out-of-window case is handled by host-side
+  requeueing).
+
+- **Vote bitmaps.** Acceptor votes are a uint32 bitmap per (group, slot);
+  quorum = ``population_count(votes) >= majority(members)``.  Caps groups
+  at 32 replicas (the reference is practically ≤ ~10).
+
+- **Request ids.** The device stores only 64-bit request ids (two int32
+  lanes); payload bytes stay host-side keyed by id, mirroring the
+  reference's split between ``RequestPacket`` identity and body.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+# --- packed ballots ---------------------------------------------------------
+
+NODE_BITS = 12
+NODE_MASK = (1 << NODE_BITS) - 1
+NO_BALLOT = -1  # sorts below every packed ballot (packed values are >= 0)
+NO_SLOT = -1
+
+
+def pack_ballot(num: int, coord: int):
+    """Pack (ballotNumber, coordinatorID) into one comparable int32."""
+    return (num << NODE_BITS) | (coord & NODE_MASK)
+
+
+def unpack_ballot(packed: int) -> Tuple[int, int]:
+    if packed < 0:
+        return (-1, -1)
+    return (packed >> NODE_BITS, packed & NODE_MASK)
+
+
+# --- the state --------------------------------------------------------------
+
+
+class ColumnarState(NamedTuple):
+    """All-groups paxos state as device arrays.  Shapes: [G] or [G, W]."""
+
+    # -- group table --
+    active: jnp.ndarray        # bool[G]  row allocated
+    members: jnp.ndarray       # i32[G]   replica count N (quorum = N//2+1)
+    version: jnp.ndarray       # i32[G]   reconfiguration epoch of the group
+
+    # -- acceptor (ref: PaxosAcceptor.java) --
+    bal: jnp.ndarray           # i32[G]   promised ballot (packed)
+    acc_bal: jnp.ndarray       # i32[G,W] ballot of accepted pvalue (packed)
+    acc_slot: jnp.ndarray      # i32[G,W] slot held by this column (-1 none)
+    acc_req_lo: jnp.ndarray    # i32[G,W] request id low 32
+    acc_req_hi: jnp.ndarray    # i32[G,W] request id high 32
+    dec: jnp.ndarray           # bool[G,W] decided flag
+    dec_slot: jnp.ndarray      # i32[G,W]
+    dec_req_lo: jnp.ndarray    # i32[G,W]
+    dec_req_hi: jnp.ndarray    # i32[G,W]
+    exec_cursor: jnp.ndarray   # i32[G]   first not-known-decided contiguous slot
+    gc_slot: jnp.ndarray       # i32[G]   checkpointed slot (log GC'd below)
+
+    # -- coordinator (ref: PaxosCoordinator/PaxosCoordinatorState.java) --
+    is_coord: jnp.ndarray      # bool[G]  this node believes it coordinates g
+    coord_active: jnp.ndarray  # bool[G]  phase-1 complete, may assign slots
+    cbal: jnp.ndarray          # i32[G]   coordinator ballot (packed)
+    next_slot: jnp.ndarray     # i32[G]   next slot to assign
+    prep_votes: jnp.ndarray    # u32[G]   phase-1 prepare-reply bitmap
+    votes: jnp.ndarray         # u32[G,W] accept-reply bitmaps
+    vote_slot: jnp.ndarray     # i32[G,W] slot the votes column refers to
+    prop_req_lo: jnp.ndarray   # i32[G,W] request id this coord proposed
+    prop_req_hi: jnp.ndarray   # i32[G,W]
+    emitted: jnp.ndarray       # bool[G,W] decision already emitted for column
+
+    @property
+    def G(self) -> int:
+        return self.bal.shape[0]
+
+    @property
+    def W(self) -> int:
+        return self.acc_bal.shape[1]
+
+
+def make_state(G: int, W: int) -> ColumnarState:
+    """Fresh all-inactive state.  G groups capacity, window width W."""
+    i32 = jnp.int32
+    u32 = jnp.uint32
+
+    # NOTE: every field gets its OWN buffer — sharing one zeros array across
+    # fields breaks donate_argnums ("attempt to donate the same buffer
+    # twice").
+    def zG():
+        return jnp.zeros((G,), i32)
+
+    def zGW():
+        return jnp.zeros((G, W), i32)
+
+    return ColumnarState(
+        active=jnp.zeros((G,), jnp.bool_),
+        members=zG(),
+        version=zG(),
+        bal=jnp.full((G,), NO_BALLOT, i32),
+        acc_bal=jnp.full((G, W), NO_BALLOT, i32),
+        acc_slot=jnp.full((G, W), NO_SLOT, i32),
+        acc_req_lo=zGW(),
+        acc_req_hi=zGW(),
+        dec=jnp.zeros((G, W), jnp.bool_),
+        dec_slot=jnp.full((G, W), NO_SLOT, i32),
+        dec_req_lo=zGW(),
+        dec_req_hi=zGW(),
+        exec_cursor=zG(),
+        gc_slot=jnp.full((G,), NO_SLOT, i32),
+        is_coord=jnp.zeros((G,), jnp.bool_),
+        coord_active=jnp.zeros((G,), jnp.bool_),
+        cbal=jnp.full((G,), NO_BALLOT, i32),
+        next_slot=zG(),
+        prep_votes=jnp.zeros((G,), u32),
+        votes=jnp.zeros((G, W), u32),
+        vote_slot=jnp.full((G, W), NO_SLOT, i32),
+        prop_req_lo=zGW(),
+        prop_req_hi=zGW(),
+        emitted=jnp.zeros((G, W), jnp.bool_),
+    )
+
+
+def split_req_id(req_id: int) -> Tuple[int, int]:
+    """64-bit request id -> (lo32, hi32) as signed int32-safe Python ints."""
+    lo = req_id & 0xFFFFFFFF
+    hi = (req_id >> 32) & 0xFFFFFFFF
+    # to signed
+    if lo >= 1 << 31:
+        lo -= 1 << 32
+    if hi >= 1 << 31:
+        hi -= 1 << 32
+    return lo, hi
+
+
+def join_req_id(lo: int, hi: int) -> int:
+    return ((int(hi) & 0xFFFFFFFF) << 32) | (int(lo) & 0xFFFFFFFF)
+
+
+def state_nbytes(G: int, W: int) -> int:
+    """Approximate device bytes for a state of this capacity."""
+    per_g = 4 * 9 + 3  # i32[G] fields + bools
+    per_gw = 4 * 12 + 2  # i32/u32 [G,W] fields + bools
+    return G * per_g + G * W * per_gw

@@ -1,0 +1,14 @@
+"""L0 utilities: config, profiling, logging.
+
+Reference analog: ``src/edu/umass/cs/utils/`` (Config, DelayProfiler, Util).
+The reference's memory-density helpers (MultiArrayMap, DiskMap) have no
+direct analog here: the rebuild stores per-group state columnar in device
+arrays (see ``gigapaxos_tpu.ops``) and a dense host-side row allocator
+(see ``gigapaxos_tpu.paxos.grouptable``), which is the TPU-native answer to
+the same "millions of groups per node" problem.
+"""
+
+from gigapaxos_tpu.utils.config import Config, ConfigKey
+from gigapaxos_tpu.utils.profiler import DelayProfiler
+
+__all__ = ["Config", "ConfigKey", "DelayProfiler"]

@@ -1,0 +1,109 @@
+"""Global EWMA latency/throughput instrumentation.
+
+Reference analog: ``src/edu/umass/cs/utils/DelayProfiler.java`` — global
+moving-average stats updated inline at every hot-path stage and dumped
+periodically as one line.  Same API shape: ``updateDelay(tag, t0)`` computes
+``now - t0``; ``updateValue`` tracks an arbitrary moving average;
+``updateRate`` counts events/sec; ``get_stats()`` renders one line.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict
+
+
+class _EWMA:
+    __slots__ = ("value", "alpha", "count")
+
+    def __init__(self, alpha: float = 0.1):
+        self.value = 0.0
+        self.alpha = alpha
+        self.count = 0
+
+    def update(self, sample: float) -> None:
+        if self.count == 0:
+            self.value = sample
+        else:
+            self.value += self.alpha * (sample - self.value)
+        self.count += 1
+
+
+class _Rate:
+    __slots__ = ("count", "t0")
+
+    def __init__(self):
+        self.count = 0
+        self.t0 = time.monotonic()
+
+    def update(self, n: int = 1) -> None:
+        self.count += n
+
+    @property
+    def per_sec(self) -> float:
+        dt = time.monotonic() - self.t0
+        return self.count / dt if dt > 0 else 0.0
+
+
+class DelayProfiler:
+    """Process-global profiler; all methods are thread-safe and cheap."""
+
+    _lock = threading.Lock()
+    _delays: Dict[str, _EWMA] = {}
+    _values: Dict[str, _EWMA] = {}
+    _rates: Dict[str, _Rate] = {}
+    enabled: bool = True
+
+    @classmethod
+    def update_delay(cls, tag: str, t0: float, n: int = 1) -> None:
+        """Record ``(now - t0)/n`` seconds under ``tag`` (EWMA)."""
+        if not cls.enabled:
+            return
+        sample = (time.monotonic() - t0) / max(n, 1)
+        with cls._lock:
+            cls._delays.setdefault(tag, _EWMA()).update(sample)
+
+    @classmethod
+    def update_value(cls, tag: str, sample: float) -> None:
+        if not cls.enabled:
+            return
+        with cls._lock:
+            cls._values.setdefault(tag, _EWMA()).update(sample)
+
+    @classmethod
+    def update_rate(cls, tag: str, n: int = 1) -> None:
+        if not cls.enabled:
+            return
+        with cls._lock:
+            cls._rates.setdefault(tag, _Rate()).update(n)
+
+    @classmethod
+    def get(cls, tag: str) -> float:
+        with cls._lock:
+            if tag in cls._delays:
+                return cls._delays[tag].value
+            if tag in cls._values:
+                return cls._values[tag].value
+            if tag in cls._rates:
+                return cls._rates[tag].per_sec
+            return 0.0
+
+    @classmethod
+    def get_stats(cls) -> str:
+        with cls._lock:
+            parts = []
+            for tag, e in sorted(cls._delays.items()):
+                parts.append(f"{tag}={e.value*1e3:.3f}ms[{e.count}]")
+            for tag, e in sorted(cls._values.items()):
+                parts.append(f"{tag}={e.value:.3f}[{e.count}]")
+            for tag, r in sorted(cls._rates.items()):
+                parts.append(f"{tag}={r.per_sec:.1f}/s[{r.count}]")
+            return " ".join(parts)
+
+    @classmethod
+    def clear(cls) -> None:
+        with cls._lock:
+            cls._delays.clear()
+            cls._values.clear()
+            cls._rates.clear()

@@ -1,0 +1,35 @@
+"""Test env: force JAX onto a virtual 8-device CPU mesh.
+
+The override goes through ``jax.config`` (not the JAX_PLATFORMS env var) so
+that environments which pre-pin a platform at interpreter startup can't
+interfere.  Set GP_TEST_TPU=1 to run the suite on real TPU hardware
+instead.
+"""
+
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+if not os.environ.get("GP_TEST_TPU"):
+    jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _clean_config():
+    from gigapaxos_tpu.utils.config import Config
+    yield
+    Config.clear()
+
+
+@pytest.fixture(autouse=True)
+def _clean_profiler():
+    from gigapaxos_tpu.utils.profiler import DelayProfiler
+    yield
+    DelayProfiler.clear()

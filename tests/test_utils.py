@@ -1,0 +1,60 @@
+"""L0 utils tests (config layering, profiler)."""
+
+import os
+
+from gigapaxos_tpu.utils.config import Config, ConfigKey
+from gigapaxos_tpu.utils.profiler import DelayProfiler
+
+
+class TC(ConfigKey):
+    BATCH_SIZE = 1024
+    TIMEOUT = 0.5
+    NAME = "default"
+    FLAG = False
+
+
+def test_defaults():
+    assert Config.get(TC.BATCH_SIZE) == 1024
+    assert Config.get(TC.TIMEOUT) == 0.5
+    assert Config.get(TC.NAME) == "default"
+    assert Config.get(TC.FLAG) is False
+
+
+def test_programmatic_override():
+    Config.set(TC.BATCH_SIZE, 8)
+    assert Config.get(TC.BATCH_SIZE) == 8
+    Config.unset(TC.BATCH_SIZE)
+    assert Config.get(TC.BATCH_SIZE) == 1024
+
+
+def test_properties_file(tmp_path):
+    p = tmp_path / "gp.properties"
+    p.write_text("# comment\nTC.BATCH_SIZE=77\nTC.FLAG=true\n"
+                 "active.node0=127.0.0.1:2000\n")
+    Config.load(str(p))
+    assert Config.get(TC.BATCH_SIZE) == 77
+    assert Config.get(TC.FLAG) is True
+    assert Config.raw_properties("active.") == {
+        "active.node0": "127.0.0.1:2000"}
+
+
+def test_env_override(tmp_path, monkeypatch):
+    p = tmp_path / "gp.properties"
+    p.write_text("TC.BATCH_SIZE=77\n")
+    Config.load(str(p))
+    monkeypatch.setenv("GP_TC_BATCH_SIZE", "99")
+    assert Config.get(TC.BATCH_SIZE) == 99
+    # programmatic beats env
+    Config.set(TC.BATCH_SIZE, 5)
+    assert Config.get(TC.BATCH_SIZE) == 5
+
+
+def test_profiler():
+    import time
+    t0 = time.monotonic()
+    DelayProfiler.update_delay("accept", t0)
+    DelayProfiler.update_value("batch_size", 128)
+    DelayProfiler.update_rate("decisions", 10)
+    assert DelayProfiler.get("batch_size") == 128
+    s = DelayProfiler.get_stats()
+    assert "accept" in s and "batch_size" in s and "decisions" in s
