@@ -1,0 +1,302 @@
+"""Asyncio TCP transport with length-prefixed frames.
+
+Reference analog: ``nio/NIOTransport.java`` (single-selector non-blocking
+TCP with connection cache, auto-reconnect, per-destination send queues with
+byte-budget backpressure and congestion drop) + ``nio/MessageNIOTransport``
+(length-prefixed typed frames) + ``nio/MessageExtractor`` (reassembly) —
+re-expressed on asyncio: the event loop is the selector; per-destination
+writer tasks are the send queues; ``asyncio.StreamReader.readexactly`` is
+the extractor.
+
+Capabilities kept from the reference:
+
+- connect-on-demand with retry/backoff, connection cache keyed by node id
+- per-destination byte budget; frames beyond it are DROPPED and counted
+  (congestion drop — paxos tolerates loss; ref NIOTransport drops too)
+- replies to un-mapped senders (clients) ride the inbound connection —
+  the analog of the reference's ``ClientMessenger`` reply plumbing
+- optional TLS (SERVER_AUTH / MUTUAL_AUTH analog via ssl contexts)
+- byte/packet counters (ref: ``NIOInstrumenter``)
+
+Threading model: all methods must be called on the transport's event loop
+except :meth:`send_threadsafe`, which marshals onto it — the node runtime's
+kernel worker thread uses that.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import ssl as ssl_mod
+import struct
+from collections import deque
+from typing import Awaitable, Callable, Dict, Optional, Tuple
+
+from gigapaxos_tpu.utils.logutil import get_logger
+from gigapaxos_tpu.utils.profiler import DelayProfiler
+
+log = get_logger("gp.net")
+
+_LEN = struct.Struct("<I")
+MAX_FRAME = 64 * 1024 * 1024
+
+
+class Demultiplexer:
+    """Per-packet-type handler registry.
+
+    Ref: ``nio/AbstractPacketDemultiplexer`` — ``register(type)`` +
+    ``handleMessage``.  Handlers run on the event loop; anything heavy must
+    hand off to the node's worker (the reference's thread-pool demux
+    becomes an explicit hand-off queue in the node runtime).
+    """
+
+    def __init__(self):
+        self._handlers: Dict[int, Callable] = {}
+
+    def register(self, ptype: int, handler: Callable) -> None:
+        self._handlers[int(ptype)] = handler
+
+    def dispatch(self, frame: bytes) -> bool:
+        ptype = frame[0]
+        h = self._handlers.get(ptype)
+        if h is None:
+            log.warning("no handler for packet type %d", ptype)
+            return False
+        h(frame)
+        return True
+
+
+class _Peer:
+    __slots__ = ("queue", "bytes_queued", "task", "writer", "wake")
+
+    def __init__(self):
+        self.queue: deque = deque()
+        self.bytes_queued = 0
+        self.task: Optional[asyncio.Task] = None
+        self.writer: Optional[asyncio.StreamWriter] = None
+        self.wake = asyncio.Event()
+
+
+class Transport:
+    """One node's transport endpoint."""
+
+    def __init__(self, node_id: int, listen_addr: Tuple[str, int],
+                 addr_map: Dict[int, Tuple[str, int]],
+                 on_frame: Callable[[bytes], None],
+                 max_queue_bytes: int = 32 * 1024 * 1024,
+                 ssl_server: Optional[ssl_mod.SSLContext] = None,
+                 ssl_client: Optional[ssl_mod.SSLContext] = None,
+                 reconnect_base_s: float = 0.05):
+        self.id = node_id
+        self.listen_addr = listen_addr
+        self.addr_map = dict(addr_map)
+        self.on_frame = on_frame
+        self.max_queue_bytes = max_queue_bytes
+        self.ssl_server = ssl_server
+        self.ssl_client = ssl_client
+        self.reconnect_base_s = reconnect_base_s
+
+        self._peers: Dict[int, _Peer] = {}
+        # inbound connections from ids not in addr_map (clients): replies
+        # go back over these writers
+        self._inbound: Dict[int, asyncio.StreamWriter] = {}
+        self._inbound_tasks: set = set()
+        self._server: Optional[asyncio.base_events.Server] = None
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._closed = False
+
+        # NIOInstrumenter analog
+        self.sent_frames = 0
+        self.sent_bytes = 0
+        self.rcvd_frames = 0
+        self.rcvd_bytes = 0
+        self.dropped_frames = 0
+
+    # -- lifecycle ---------------------------------------------------------
+
+    async def start(self) -> None:
+        self._loop = asyncio.get_running_loop()
+        host, port = self.listen_addr
+        self._server = await asyncio.start_server(
+            self._handle_inbound, host, port, ssl=self.ssl_server)
+
+    async def stop(self) -> None:
+        self._closed = True
+        for p in self._peers.values():
+            if p.task:
+                p.task.cancel()
+            if p.writer:
+                p.writer.close()
+        # cancel inbound handlers BEFORE wait_closed: since py3.12
+        # Server.wait_closed() waits for handler coroutines, which would
+        # otherwise sit in readexactly() forever
+        for t in list(self._inbound_tasks):
+            t.cancel()
+        for w in list(self._inbound.values()):
+            w.close()
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+        await asyncio.sleep(0)
+
+    @property
+    def port(self) -> int:
+        return self._server.sockets[0].getsockname()[1]
+
+    # -- sending -----------------------------------------------------------
+
+    def send(self, dst: int, frame: bytes) -> bool:
+        """Queue a frame to node ``dst``.  Returns False on congestion drop
+        or unknown destination.  Must be called on the loop."""
+        if dst in self.addr_map:
+            peer = self._peers.get(dst)
+            if peer is None:
+                peer = self._peers[dst] = _Peer()
+                peer.task = self._loop.create_task(self._writer_loop(dst))
+            if peer.bytes_queued + len(frame) > self.max_queue_bytes:
+                self.dropped_frames += 1
+                DelayProfiler.update_rate("net.drop")
+                return False
+            peer.queue.append(frame)
+            peer.bytes_queued += len(frame)
+            peer.wake.set()
+            return True
+        # reply path over an inbound connection (client or unknown peer)
+        w = self._inbound.get(dst)
+        if w is None or w.is_closing():
+            self.dropped_frames += 1
+            return False
+        self._write_frame(w, frame)
+        return True
+
+    def send_threadsafe(self, dst: int, frame: bytes) -> None:
+        self._loop.call_soon_threadsafe(self.send, dst, frame)
+
+    def _write_frame(self, w: asyncio.StreamWriter, frame: bytes) -> None:
+        w.write(_LEN.pack(len(frame)))
+        w.write(frame)
+        self.sent_frames += 1
+        self.sent_bytes += len(frame) + 4
+
+    # -- per-destination writer task --------------------------------------
+
+    async def _writer_loop(self, dst: int) -> None:
+        peer = self._peers[dst]
+        backoff = self.reconnect_base_s
+        while not self._closed:
+            # (re)connect
+            host, port = self.addr_map[dst]
+            try:
+                reader, writer = await asyncio.open_connection(
+                    host, port, ssl=self.ssl_client)
+            except OSError:
+                await asyncio.sleep(backoff)
+                backoff = min(backoff * 2, 2.0)
+                continue
+            backoff = self.reconnect_base_s
+            peer.writer = writer
+            # handshake: identify ourselves so the far side can map the
+            # connection to our node id (replies to unmapped ids)
+            writer.write(_LEN.pack(4) + struct.pack("<i", self.id))
+            # connections are bidirectional: the far side may send replies
+            # back over this link (client reply path), so read it too
+            rtask = self._loop.create_task(self._read_frames(reader))
+            try:
+                while not self._closed:
+                    while peer.queue:
+                        frame = peer.queue.popleft()
+                        peer.bytes_queued -= len(frame)
+                        self._write_frame(writer, frame)
+                    await writer.drain()
+                    if not peer.queue:
+                        peer.wake.clear()
+                        await peer.wake.wait()
+            except asyncio.CancelledError:
+                return
+            except (ConnectionError, OSError):
+                pass  # drop through to reconnect
+            finally:
+                rtask.cancel()
+                peer.writer = None
+                writer.close()
+
+    async def _read_frames(self, reader: asyncio.StreamReader) -> None:
+        """Frame-read loop for the *outbound* side of a connection."""
+        try:
+            while True:
+                hdr = await reader.readexactly(4)
+                (ln,) = _LEN.unpack(hdr)
+                if ln > MAX_FRAME:
+                    return
+                frame = await reader.readexactly(ln)
+                self.rcvd_frames += 1
+                self.rcvd_bytes += ln + 4
+                self._dispatch(frame)
+        except (asyncio.IncompleteReadError, ConnectionError, OSError,
+                asyncio.CancelledError):
+            pass
+
+    def _dispatch(self, frame: bytes) -> None:
+        """on_frame with a crash guard: one malformed/unknown frame must
+        not kill the connection's read loop (version skew, corruption)."""
+        try:
+            self.on_frame(frame)
+        except Exception:
+            log.exception("handler failed for frame type %d",
+                          frame[0] if frame else -1)
+
+    # -- inbound -----------------------------------------------------------
+
+    async def _handle_inbound(self, reader: asyncio.StreamReader,
+                              writer: asyncio.StreamWriter) -> None:
+        peer_id: Optional[int] = None
+        task = asyncio.current_task()
+        self._inbound_tasks.add(task)
+        try:
+            # first frame = 4-byte id handshake
+            hdr = await reader.readexactly(4)
+            (ln,) = _LEN.unpack(hdr)
+            if ln != 4:
+                writer.close()
+                return
+            (peer_id,) = struct.unpack("<i", await reader.readexactly(4))
+            self._inbound[peer_id] = writer
+            while True:
+                hdr = await reader.readexactly(4)
+                (ln,) = _LEN.unpack(hdr)
+                if ln > MAX_FRAME:
+                    log.error("oversized frame %d from %s", ln, peer_id)
+                    return
+                frame = await reader.readexactly(ln)
+                self.rcvd_frames += 1
+                self.rcvd_bytes += ln + 4
+                self._dispatch(frame)
+        except (asyncio.IncompleteReadError, ConnectionError, OSError,
+                asyncio.CancelledError):
+            pass
+        finally:
+            self._inbound_tasks.discard(task)
+            if peer_id is not None and self._inbound.get(peer_id) is writer:
+                del self._inbound[peer_id]
+            writer.close()
+
+    def stats(self) -> str:
+        return (f"tx={self.sent_frames}f/{self.sent_bytes}B "
+                f"rx={self.rcvd_frames}f/{self.rcvd_bytes}B "
+                f"drop={self.dropped_frames}")
+
+
+def make_ssl_contexts(certfile: str, keyfile: str, cafile: str,
+                      mutual: bool = False
+                      ) -> Tuple[ssl_mod.SSLContext, ssl_mod.SSLContext]:
+    """(server_ctx, client_ctx) — SERVER_AUTH by default, MUTUAL_AUTH when
+    ``mutual`` (ref: ``SSLDataProcessingWorker.SSL_MODES``)."""
+    server = ssl_mod.SSLContext(ssl_mod.PROTOCOL_TLS_SERVER)
+    server.load_cert_chain(certfile, keyfile)
+    client = ssl_mod.SSLContext(ssl_mod.PROTOCOL_TLS_CLIENT)
+    client.load_verify_locations(cafile)
+    client.check_hostname = False
+    if mutual:
+        server.verify_mode = ssl_mod.CERT_REQUIRED
+        server.load_verify_locations(cafile)
+        client.load_cert_chain(certfile, keyfile)
+    return server, client

@@ -79,15 +79,15 @@ class AcceptOut(NamedTuple):
 
 def accept_batch(state: ColumnarState, g, slot, bal, rlo, rhi, valid):
     G, W = state.G, state.W
-    si = _si(g, valid, G)
     gi = _gi(g, valid)
-
-    item_bal = jnp.where(valid, bal, NO_BALLOT)
-    new_bal = state.bal.at[si].max(item_bal, mode="drop")
-    cur_bal = new_bal[gi]
     act = state.active[gi]
+    live = valid & act  # inactive rows must not be mutated at all
 
-    promised_ok = valid & act & (bal >= cur_bal)
+    item_bal = jnp.where(live, bal, NO_BALLOT)
+    new_bal = state.bal.at[_si(g, live, G)].max(item_bal, mode="drop")
+    cur_bal = new_bal[gi]
+
+    promised_ok = live & (bal >= cur_bal)
     cursor = state.exec_cursor[gi]
     stale = valid & act & (slot < cursor)
     in_win = (slot >= cursor) & (slot < cursor + W)
@@ -327,13 +327,13 @@ def prepare_batch(state: ColumnarState, g, bal, valid):
     firstUndecidedSlot; here that is exactly the row slice — SURVEY §7.3.4).
     """
     G, W = state.G, state.W
-    si = _si(g, valid, G)
     gi = _gi(g, valid)
+    live = valid & state.active[gi]  # don't mutate inactive rows
 
-    item_bal = jnp.where(valid, bal, NO_BALLOT)
-    new_bal = state.bal.at[si].max(item_bal, mode="drop")
+    item_bal = jnp.where(live, bal, NO_BALLOT)
+    new_bal = state.bal.at[_si(g, live, G)].max(item_bal, mode="drop")
     cur_bal = new_bal[gi]
-    acked = valid & state.active[gi] & (bal >= cur_bal)
+    acked = live & (bal >= cur_bal)
 
     out = PrepareOut(
         acked=acked,

@@ -164,6 +164,6 @@ def join_req_id(lo: int, hi: int) -> int:
 
 def state_nbytes(G: int, W: int) -> int:
     """Approximate device bytes for a state of this capacity."""
-    per_g = 4 * 9 + 3  # i32[G] fields + bools
-    per_gw = 4 * 12 + 2  # i32/u32 [G,W] fields + bools
+    per_g = 4 * 8 + 3   # 8 i32/u32 [G] fields + 3 bool [G] fields
+    per_gw = 4 * 11 + 2  # 11 i32/u32 [G,W] fields + 2 bool [G,W] fields
     return G * per_g + G * W * per_gw

@@ -1,0 +1,5 @@
+"""L2/L3: paxos node runtime — packets, durable log, backends, manager.
+
+Reference analog: ``src/edu/umass/cs/gigapaxos/`` (PaxosManager,
+paxospackets, AbstractPaxosLogger/SQLPaxosLogger, batchers, client).
+"""

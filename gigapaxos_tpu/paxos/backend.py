@@ -1,0 +1,494 @@
+"""AcceptorBackend SPI: pluggable consensus data planes.
+
+This is the SPI the north star calls for (BASELINE.json): the node runtime
+(PaxosManager analog) drives ALL acceptor/coordinator state transitions
+through this batch-level interface, and two backends implement it:
+
+- :class:`ScalarBackend` — one Python object per group
+  (``ops.oracle.OracleGroup``), looping over batch items.  This is the
+  architectural stand-in for the reference's per-instance Java hot path
+  (``PaxosManager`` dispatching each packet to a heap-allocated
+  ``PaxosInstanceStateMachine``) and provides the baseline side of the
+  ≥10× comparison.
+- :class:`ColumnarBackend` — the JAX/TPU columnar kernels over ``[G, W]``
+  device arrays (``ops.kernels``), with batch padding to power-of-two
+  buckets so the jit cache stays small.
+
+All inputs/outputs are numpy arrays (host-side); the manager's batcher
+builds them straight from decoded struct-of-arrays packets.
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Dict, NamedTuple, Optional
+
+import numpy as np
+
+from gigapaxos_tpu.ops.oracle import OracleGroup, PValue, make_oracle_group
+from gigapaxos_tpu.ops.types import NO_BALLOT, NO_SLOT
+
+
+class AcceptRes(NamedTuple):
+    acked: np.ndarray
+    stale: np.ndarray
+    out_window: np.ndarray
+    cur_bal: np.ndarray
+
+
+class AcceptReplyRes(NamedTuple):
+    newly_decided: np.ndarray
+    preempted: np.ndarray
+    req_lo: np.ndarray
+    req_hi: np.ndarray
+    dec_bal: np.ndarray
+
+
+class ProposeRes(NamedTuple):
+    granted: np.ndarray
+    rejected: np.ndarray
+    throttled: np.ndarray
+    slot: np.ndarray
+    cbal: np.ndarray
+
+
+class CommitRes(NamedTuple):
+    applied: np.ndarray
+    stale: np.ndarray
+    out_window: np.ndarray
+    new_cursor: np.ndarray
+
+
+class PrepareRes(NamedTuple):
+    acked: np.ndarray
+    cur_bal: np.ndarray
+    exec_cursor: np.ndarray
+    win_slot: np.ndarray    # [B, W]
+    win_bal: np.ndarray
+    win_req_lo: np.ndarray
+    win_req_hi: np.ndarray
+
+
+def _split64(req: np.ndarray):
+    """u64/int64 request-id array -> (lo32, hi32) int32 views."""
+    req = np.ascontiguousarray(req, np.uint64)
+    lo = (req & np.uint64(0xFFFFFFFF)).astype(np.uint32).view(np.int32)
+    hi = (req >> np.uint64(32)).astype(np.uint32).view(np.int32)
+    return lo, hi
+
+
+def _join64(lo: np.ndarray, hi: np.ndarray) -> np.ndarray:
+    return (lo.view(np.uint32).astype(np.uint64) |
+            (hi.view(np.uint32).astype(np.uint64) << np.uint64(32)))
+
+
+class AcceptorBackend(abc.ABC):
+    """Batch-level consensus state-transition engine for all groups of one
+    node.  Rows are the dense indices from ``GroupTable``."""
+
+    @property
+    @abc.abstractmethod
+    def window(self) -> int: ...
+
+    @abc.abstractmethod
+    def create(self, rows, members, versions, init_bal, self_coord): ...
+
+    @abc.abstractmethod
+    def delete(self, rows): ...
+
+    @abc.abstractmethod
+    def accept(self, rows, slots, bals, req_ids) -> AcceptRes: ...
+
+    @abc.abstractmethod
+    def accept_reply(self, rows, slots, bals, senders, acked
+                     ) -> AcceptReplyRes: ...
+
+    @abc.abstractmethod
+    def propose(self, rows, req_ids) -> ProposeRes: ...
+
+    @abc.abstractmethod
+    def commit(self, rows, slots, req_ids) -> CommitRes: ...
+
+    @abc.abstractmethod
+    def prepare(self, rows, bals) -> PrepareRes: ...
+
+    @abc.abstractmethod
+    def install_coordinator(self, rows, cbals, next_slots, carry_slot,
+                            carry_req) -> None: ...
+
+    @abc.abstractmethod
+    def set_cursor(self, rows, cursors, next_slots) -> None: ...
+
+    @abc.abstractmethod
+    def gc(self, rows, upto) -> None: ...
+
+    @abc.abstractmethod
+    def cursor_of(self, row: int) -> int: ...
+
+    @abc.abstractmethod
+    def snapshot_row(self, row: int) -> dict:
+        """Serializable per-row hot state (pause; ref HotRestoreInfo)."""
+
+    @abc.abstractmethod
+    def restore_row(self, row: int, snap: dict) -> None: ...
+
+
+# --------------------------------------------------------------------------
+# scalar backend (baseline / trickle-traffic path)
+# --------------------------------------------------------------------------
+
+
+class ScalarBackend(AcceptorBackend):
+    """Per-instance Python objects; the reference-architecture stand-in."""
+
+    def __init__(self, window: int = 16):
+        self._window = window
+        self.groups: Dict[int, OracleGroup] = {}
+
+    @property
+    def window(self) -> int:
+        return self._window
+
+    def _g(self, row: int) -> Optional[OracleGroup]:
+        return self.groups.get(int(row))
+
+    def create(self, rows, members, versions, init_bal, self_coord):
+        for i in range(len(rows)):
+            self.groups[int(rows[i])] = make_oracle_group(
+                int(members[i]), self._window, int(init_bal[i]),
+                bool(self_coord[i]), int(versions[i]))
+
+    def delete(self, rows):
+        for r in rows:
+            self.groups.pop(int(r), None)
+
+    def accept(self, rows, slots, bals, req_ids) -> AcceptRes:
+        n = len(rows)
+        acked = np.zeros(n, bool)
+        stale = np.zeros(n, bool)
+        ow = np.zeros(n, bool)
+        cur = np.full(n, NO_BALLOT, np.int32)
+        for i in range(n):
+            g = self._g(rows[i])
+            if g is None:
+                continue
+            acked[i], stale[i], ow[i], cur[i] = g.accept(
+                int(slots[i]), int(bals[i]), int(req_ids[i]))
+        return AcceptRes(acked, stale, ow, cur)
+
+    def accept_reply(self, rows, slots, bals, senders, acked
+                     ) -> AcceptReplyRes:
+        n = len(rows)
+        newly = np.zeros(n, bool)
+        pre = np.zeros(n, bool)
+        rlo = np.zeros(n, np.int32)
+        rhi = np.zeros(n, np.int32)
+        dbal = np.full(n, NO_BALLOT, np.int32)
+        for i in range(n):
+            g = self._g(rows[i])
+            if g is None:
+                continue
+            nd, p, req = g.accept_reply(int(slots[i]), int(bals[i]),
+                                        int(senders[i]), bool(acked[i]))
+            newly[i], pre[i] = nd, p
+            if nd:
+                dbal[i] = g.cbal
+                r = np.asarray([req], np.uint64)
+                lo, hi = _split64(r)
+                rlo[i], rhi[i] = lo[0], hi[0]
+        return AcceptReplyRes(newly, pre, rlo, rhi, dbal)
+
+    def propose(self, rows, req_ids) -> ProposeRes:
+        n = len(rows)
+        granted = np.zeros(n, bool)
+        rejected = np.zeros(n, bool)
+        throttled = np.zeros(n, bool)
+        slot = np.full(n, NO_SLOT, np.int32)
+        cbal = np.full(n, NO_BALLOT, np.int32)
+        for i in range(n):
+            g = self._g(rows[i])
+            if g is None:
+                continue
+            st, s, cb = g.propose(int(req_ids[i]))
+            granted[i] = st == "granted"
+            rejected[i] = st == "rejected"
+            throttled[i] = st == "throttled"
+            slot[i], cbal[i] = s, cb
+        return ProposeRes(granted, rejected, throttled, slot, cbal)
+
+    def commit(self, rows, slots, req_ids) -> CommitRes:
+        n = len(rows)
+        applied = np.zeros(n, bool)
+        stale = np.zeros(n, bool)
+        ow = np.zeros(n, bool)
+        cur = np.zeros(n, np.int32)
+        for i in range(n):
+            g = self._g(rows[i])
+            if g is None:
+                continue
+            applied[i], stale[i], ow[i], cur[i] = g.commit(
+                int(slots[i]), int(req_ids[i]))
+        return CommitRes(applied, stale, ow, cur)
+
+    def prepare(self, rows, bals) -> PrepareRes:
+        n = len(rows)
+        W = self._window
+        acked = np.zeros(n, bool)
+        cur_bal = np.full(n, NO_BALLOT, np.int32)
+        cursor = np.zeros(n, np.int32)
+        ws = np.full((n, W), NO_SLOT, np.int32)
+        wb = np.full((n, W), NO_BALLOT, np.int32)
+        wl = np.zeros((n, W), np.int32)
+        wh = np.zeros((n, W), np.int32)
+        for i in range(n):
+            g = self._g(rows[i])
+            if g is None:
+                continue
+            a, cb, cu, pvs = g.prepare(int(bals[i]))
+            acked[i], cur_bal[i], cursor[i] = a, cb, cu
+            for j, pv in enumerate(pvs[:W]):
+                ws[i, j] = pv.slot
+                wb[i, j] = pv.bal
+                r = np.asarray([pv.req_id], np.uint64)
+                lo, hi = _split64(r)
+                wl[i, j], wh[i, j] = lo[0], hi[0]
+        return PrepareRes(acked, cur_bal, cursor, ws, wb, wl, wh)
+
+    def install_coordinator(self, rows, cbals, next_slots, carry_slot,
+                            carry_req) -> None:
+        for i in range(len(rows)):
+            g = self._g(rows[i])
+            if g is None:
+                continue
+            pvs = []
+            for j in range(carry_slot.shape[1]):
+                if carry_slot[i, j] >= 0:
+                    pvs.append(PValue(int(carry_slot[i, j]), 0,
+                                      int(carry_req[i, j])))
+            g.install_coordinator(int(cbals[i]), int(next_slots[i]), pvs)
+
+    def set_cursor(self, rows, cursors, next_slots) -> None:
+        for i in range(len(rows)):
+            g = self._g(rows[i])
+            if g is None:
+                continue
+            g.exec_cursor = int(cursors[i])
+            g.next_slot = max(g.next_slot, int(next_slots[i]))
+
+    def gc(self, rows, upto) -> None:
+        for i in range(len(rows)):
+            g = self._g(rows[i])
+            if g is not None:
+                g.garbage_collect(int(upto[i]))
+
+    def cursor_of(self, row: int) -> int:
+        g = self._g(row)
+        return g.exec_cursor if g else 0
+
+    def snapshot_row(self, row: int) -> dict:
+        g = self.groups[int(row)]
+        return {
+            "members": g.members, "version": g.version, "bal": g.bal,
+            "accepted": [(pv.slot, pv.bal, pv.req_id)
+                         for pv in g.accepted.values()],
+            "decided": list(g.decided.items()),
+            "exec_cursor": g.exec_cursor, "gc_slot": g.gc_slot,
+            "is_coord": g.is_coord, "coord_active": g.coord_active,
+            "cbal": g.cbal, "next_slot": g.next_slot,
+        }
+
+    def restore_row(self, row: int, snap: dict) -> None:
+        g = make_oracle_group(snap["members"], self._window, snap["bal"],
+                              False, snap["version"])
+        for s, b, r in snap["accepted"]:
+            g.accepted[s] = PValue(s, b, r)
+        g.decided = dict(snap["decided"])
+        g.exec_cursor = snap["exec_cursor"]
+        g.gc_slot = snap["gc_slot"]
+        g.is_coord = snap["is_coord"]
+        g.coord_active = snap["coord_active"]
+        g.cbal = snap["cbal"]
+        g.next_slot = snap["next_slot"]
+        self.groups[int(row)] = g
+
+
+# --------------------------------------------------------------------------
+# columnar backend (the TPU data plane)
+# --------------------------------------------------------------------------
+
+
+def _bucket(n: int, lo: int = 8, hi: int = 1 << 16) -> int:
+    b = lo
+    while b < n:
+        b <<= 1
+    return min(b, hi)
+
+
+class ColumnarBackend(AcceptorBackend):
+    """JAX columnar kernels over [G, W] device arrays.
+
+    Batches are padded to power-of-two buckets (one jit specialization per
+    bucket size) with invalid lanes masked — no recompile ever depends on
+    live batch size or group occupancy (SURVEY §7.3.1).
+    """
+
+    def __init__(self, capacity: int, window: int = 16):
+        import jax
+        from gigapaxos_tpu.ops import kernels, make_state
+        self._jax = jax
+        self._k = kernels
+        self.state = make_state(capacity, window)
+        self._window = window
+        self.capacity = capacity
+
+    @property
+    def window(self) -> int:
+        return self._window
+
+    # -- padding helpers ---------------------------------------------------
+
+    def _pad1(self, arr, fill, dtype=np.int32):
+        import jax.numpy as jnp
+        n = len(arr)
+        b = _bucket(n)
+        out = np.full(b, fill, dtype)
+        out[:n] = arr
+        return jnp.asarray(out)
+
+    def _valid(self, n):
+        import jax.numpy as jnp
+        b = _bucket(n)
+        v = np.zeros(b, bool)
+        v[:n] = True
+        return jnp.asarray(v)
+
+    def _np(self, out, n):
+        """Device outputs -> host numpy, sliced back to live length."""
+        return tuple(np.asarray(x)[:n] for x in out)
+
+    # -- ops ---------------------------------------------------------------
+
+    def create(self, rows, members, versions, init_bal, self_coord):
+        n = len(rows)
+        self.state, _ = self._k.create_groups(
+            self.state, self._pad1(rows, 0), self._pad1(members, 1),
+            self._pad1(versions, 0), self._pad1(init_bal, NO_BALLOT),
+            self._pad1(self_coord, False, bool), self._valid(n))
+
+    def delete(self, rows):
+        n = len(rows)
+        self.state, _ = self._k.delete_groups(
+            self.state, self._pad1(rows, 0), self._valid(n))
+
+    def accept(self, rows, slots, bals, req_ids) -> AcceptRes:
+        n = len(rows)
+        lo, hi = _split64(req_ids)
+        self.state, o = self._k.accept(
+            self.state, self._pad1(rows, 0), self._pad1(slots, NO_SLOT),
+            self._pad1(bals, NO_BALLOT), self._pad1(lo, 0),
+            self._pad1(hi, 0), self._valid(n))
+        return AcceptRes(*self._np(o, n))
+
+    def accept_reply(self, rows, slots, bals, senders, acked
+                     ) -> AcceptReplyRes:
+        n = len(rows)
+        self.state, o = self._k.accept_reply(
+            self.state, self._pad1(rows, 0), self._pad1(slots, NO_SLOT),
+            self._pad1(bals, NO_BALLOT), self._pad1(senders, 0),
+            self._pad1(acked, False, bool), self._valid(n))
+        newly, pre, _, dbal, rlo, rhi = self._np(o, n)
+        # decision fields only meaningful on newly-decided lanes
+        rlo = np.where(newly, rlo, 0)
+        rhi = np.where(newly, rhi, 0)
+        dbal = np.where(newly, dbal, NO_BALLOT)
+        return AcceptReplyRes(newly, pre, rlo, rhi, dbal)
+
+    def propose(self, rows, req_ids) -> ProposeRes:
+        n = len(rows)
+        lo, hi = _split64(req_ids)
+        self.state, o = self._k.propose(
+            self.state, self._pad1(rows, 0), self._pad1(lo, 0),
+            self._pad1(hi, 0), self._valid(n))
+        granted, rejected, throttled, slot, cbal = self._np(o, n)
+        slot = np.where(granted, slot, NO_SLOT)  # slot only valid if granted
+        return ProposeRes(granted, rejected, throttled, slot, cbal)
+
+    def commit(self, rows, slots, req_ids) -> CommitRes:
+        n = len(rows)
+        lo, hi = _split64(req_ids)
+        self.state, o = self._k.commit(
+            self.state, self._pad1(rows, 0), self._pad1(slots, NO_SLOT),
+            self._pad1(lo, 0), self._pad1(hi, 0), self._valid(n))
+        return CommitRes(*self._np(o, n))
+
+    def prepare(self, rows, bals) -> PrepareRes:
+        n = len(rows)
+        self.state, o = self._k.prepare(
+            self.state, self._pad1(rows, 0), self._pad1(bals, NO_BALLOT),
+            self._valid(n))
+        acked, cur_bal, cursor, ws, wb, wl, wh = self._np(o, n)
+        # canonicalize the raw slot%W column layout into the SPI contract:
+        # live pvalues (slot >= exec_cursor) compacted left, sorted by slot
+        live = (ws >= 0) & (ws >= cursor[:, None])
+        order = np.argsort(np.where(live, ws, np.iinfo(np.int32).max),
+                           axis=1, kind="stable")
+        ws2 = np.where(live, ws, NO_SLOT)
+        wb2 = np.where(live, wb, NO_BALLOT)
+        wl2 = np.where(live, wl, 0)
+        wh2 = np.where(live, wh, 0)
+        tk = np.take_along_axis
+        return PrepareRes(acked, cur_bal, cursor,
+                          tk(ws2, order, 1), tk(wb2, order, 1),
+                          tk(wl2, order, 1), tk(wh2, order, 1))
+
+    def install_coordinator(self, rows, cbals, next_slots, carry_slot,
+                            carry_req) -> None:
+        import jax.numpy as jnp
+        n = len(rows)
+        b = _bucket(n)
+        W = self._window
+        cs = np.full((b, W), NO_SLOT, np.int32)
+        cl = np.zeros((b, W), np.int32)
+        ch = np.zeros((b, W), np.int32)
+        m = carry_slot.shape[1]
+        cs[:n, :m] = carry_slot
+        lo, hi = _split64(carry_req.reshape(-1))
+        cl[:n, :m] = lo.reshape(n, m)
+        ch[:n, :m] = hi.reshape(n, m)
+        self.state, _ = self._k.install_coordinator(
+            self.state, self._pad1(rows, 0), self._pad1(cbals, NO_BALLOT),
+            self._pad1(next_slots, 0), jnp.asarray(cs), jnp.asarray(cl),
+            jnp.asarray(ch), self._valid(n))
+
+    def set_cursor(self, rows, cursors, next_slots) -> None:
+        n = len(rows)
+        self.state, _ = self._k.set_cursor(
+            self.state, self._pad1(rows, 0), self._pad1(cursors, 0),
+            self._pad1(next_slots, 0), self._valid(n))
+
+    def gc(self, rows, upto) -> None:
+        n = len(rows)
+        self.state, _ = self._k.gc(
+            self.state, self._pad1(rows, 0), self._pad1(upto, NO_SLOT),
+            self._valid(n))
+
+    def cursor_of(self, row: int) -> int:
+        return int(self.state.exec_cursor[row])
+
+    def snapshot_row(self, row: int) -> dict:
+        from gigapaxos_tpu.ops.kernels import gather_rows
+        import jax
+        r = gather_rows(self.state, np.asarray([row], np.int32))
+        host = jax.device_get(r)
+        return {f: np.asarray(v[0]) for f, v in zip(host._fields, host)}
+
+    def restore_row(self, row: int, snap: dict) -> None:
+        import jax.numpy as jnp
+        from gigapaxos_tpu.ops.types import ColumnarState
+        from gigapaxos_tpu.ops.kernels import scatter_rows
+        row_state = ColumnarState(
+            **{f: jnp.asarray(snap[f])[None] for f in
+               ColumnarState._fields})
+        self.state, _ = scatter_rows(
+            self.state, jnp.asarray([row], jnp.int32), row_state,
+            jnp.asarray([True]))

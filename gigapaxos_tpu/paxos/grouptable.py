@@ -1,0 +1,81 @@
+"""Dense group-row allocator: paxosID -> device row index.
+
+Reference analog: ``utils/MultiArrayMap.java`` + ``gigapaxos/paxosutil/
+IntegerMap.java`` — the memory-dense structures that let one node hold
+millions of instances.  TPU-native redesign: instead of hashing into a
+memory-dense heap map, every group gets a *row index* into the columnar
+``[G, W]`` device arrays, allocated from a free list; create/delete churn
+reuses rows (SURVEY.md §7.3.1).  The string name appears exactly once
+(here); the wire and the device only ever see the u64 ``group_key`` and the
+i32 row.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Iterator, Optional, Tuple
+
+from gigapaxos_tpu.paxos.packets import group_key
+
+
+@dataclass
+class GroupMeta:
+    name: str
+    gkey: int
+    row: int
+    members: Tuple[int, ...]
+    version: int
+    paused: bool = False
+
+
+class GroupTable:
+    """name/gkey -> (row, members, version).  O(1) create/delete/lookup."""
+
+    def __init__(self, capacity: int):
+        self.capacity = capacity
+        self._by_key: Dict[int, GroupMeta] = {}
+        self._by_row: Dict[int, GroupMeta] = {}
+        # LIFO free list: recently freed rows are reused first, keeping the
+        # hot row set dense/cache-friendly
+        self._free = list(range(capacity - 1, -1, -1))
+
+    def __len__(self) -> int:
+        return len(self._by_key)
+
+    def create(self, name: str, members: Tuple[int, ...], version: int = 0
+               ) -> GroupMeta:
+        gkey = group_key(name)
+        existing = self._by_key.get(gkey)
+        if existing is not None:
+            if existing.name != name:
+                # 64-bit collision: refuse (SURVEY design: detect at create)
+                raise ValueError(
+                    f"group_key collision: {name!r} vs {existing.name!r}")
+            raise KeyError(f"group exists: {name}")
+        if not self._free:
+            raise MemoryError("group capacity exhausted")
+        row = self._free.pop()
+        meta = GroupMeta(name, gkey, row, tuple(members), version)
+        self._by_key[gkey] = meta
+        self._by_row[row] = meta
+        return meta
+
+    def delete(self, gkey: int) -> Optional[GroupMeta]:
+        meta = self._by_key.pop(gkey, None)
+        if meta is None:
+            return None
+        del self._by_row[meta.row]
+        self._free.append(meta.row)
+        return meta
+
+    def by_key(self, gkey: int) -> Optional[GroupMeta]:
+        return self._by_key.get(gkey)
+
+    def by_name(self, name: str) -> Optional[GroupMeta]:
+        return self._by_key.get(group_key(name))
+
+    def by_row(self, row: int) -> Optional[GroupMeta]:
+        return self._by_row.get(row)
+
+    def __iter__(self) -> Iterator[GroupMeta]:
+        return iter(self._by_key.values())

@@ -1,0 +1,566 @@
+"""Wire format: paxos packet types + compact binary codec.
+
+Reference analog: ``src/edu/umass/cs/gigapaxos/paxospackets/`` — ~15 packet
+classes with a JSON baseline plus a hand-rolled byte fast path for the hot
+types (RequestPacket, AcceptPacket, AcceptReplyPacket, Batched*).
+
+TPU-native redesign: the hot packets are *natively batched,
+struct-of-arrays*.  An ``AcceptBatch`` frame is literally parallel numpy
+arrays (group row-keys, slots, ballots, request ids) followed by a blob
+section for payload bytes — so decoding a frame yields arrays that feed the
+columnar kernels with no per-item Python loop.  This replaces the
+reference's ``PaxosPacketBatcher``-produced ``BatchedAccept``/
+``BatchedAcceptReply``/``BatchedCommit`` types AND their byteification in
+one design.
+
+Group identity on the wire is a ``u64`` stable hash of the group name
+(``group_key``); each node maps keys to its local device row via
+``paxos.grouptable``.  Name→key establishment happens at group creation,
+which detects (astronomically unlikely) 64-bit collisions and rejects the
+create — the analog of the reference's paxosID string interning via
+``IntegerMap``.
+
+Frame layout (after the transport's length prefix)::
+
+    u8 type | u16 sender | u32 n_items | fixed SoA arrays | blob section
+
+Blob section: ``u32 total | n× (u32 off)`` then concatenated bytes — blobs
+are optional per type.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import struct
+from dataclasses import dataclass, field
+from enum import IntEnum
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+def group_key(name: str) -> int:
+    """Stable 64-bit key for a group name (blake2b-8)."""
+    return int.from_bytes(
+        hashlib.blake2b(name.encode(), digest_size=8).digest(), "little")
+
+
+class PacketType(IntEnum):
+    """Analog of ``PaxosPacketType`` (+ a few transport-level types)."""
+
+    REQUEST = 1           # client -> entry replica
+    RESPONSE = 2          # entry replica -> client
+    PROPOSAL = 3          # non-coordinator replica -> coordinator
+    ACCEPT_BATCH = 4      # coordinator -> all replicas        (hot)
+    ACCEPT_REPLY_BATCH = 5  # replica -> coordinator           (hot)
+    COMMIT_BATCH = 6      # coordinator -> all replicas        (hot)
+    PREPARE = 7           # would-be coordinator -> replicas
+    PREPARE_REPLY = 8     # replica -> would-be coordinator
+    FAILURE_DETECT = 9    # ping/pong liveness
+    CREATE_GROUP = 10     # admin/control (paxos-only mode)
+    CREATE_GROUP_ACK = 11
+    DELETE_GROUP = 12
+    SYNC_REQUEST = 13     # ask for missing decisions
+    SYNC_REPLY = 14
+    CHECKPOINT_REQUEST = 15  # ask a peer for its latest app checkpoint
+    CHECKPOINT_REPLY = 16
+
+
+_HDR = struct.Struct("<BHI")  # type, sender, n_items
+
+
+def _pack_blobs(blobs: Sequence[bytes]) -> bytes:
+    offs = np.zeros(len(blobs) + 1, dtype=np.uint32)
+    total = 0
+    for i, b in enumerate(blobs):
+        total += len(b)
+        offs[i + 1] = total
+    return offs.tobytes() + b"".join(blobs)
+
+
+def _unpack_blobs(buf: memoryview, n: int) -> Tuple[List[bytes], int]:
+    offs = np.frombuffer(buf[: 4 * (n + 1)], dtype=np.uint32)
+    base = 4 * (n + 1)
+    out = [bytes(buf[base + offs[i]: base + offs[i + 1]]) for i in range(n)]
+    return out, base + int(offs[n]) if n else base
+
+
+# --------------------------------------------------------------------------
+# Struct-of-arrays hot packets
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class AcceptBatch:
+    """Coordinator → replicas: n accepts (+ request payload blobs).
+
+    Ref: ``paxospackets/AcceptPacket`` + ``BatchedAccept``; payloads ride
+    along exactly like the reference piggybacks the RequestPacket body in
+    its AcceptPacket.
+    """
+
+    sender: int
+    gkey: np.ndarray      # u64[n]
+    slot: np.ndarray      # i32[n]
+    bal: np.ndarray       # i32[n] packed ballot
+    req_lo: np.ndarray    # i32[n]
+    req_hi: np.ndarray    # i32[n]
+    payloads: List[bytes] = field(default_factory=list)
+
+    TYPE = PacketType.ACCEPT_BATCH
+
+    def encode(self) -> bytes:
+        n = len(self.gkey)
+        soa = (np.ascontiguousarray(self.gkey, np.uint64).tobytes() +
+               np.ascontiguousarray(self.slot, np.int32).tobytes() +
+               np.ascontiguousarray(self.bal, np.int32).tobytes() +
+               np.ascontiguousarray(self.req_lo, np.int32).tobytes() +
+               np.ascontiguousarray(self.req_hi, np.int32).tobytes())
+        return _HDR.pack(self.TYPE, self.sender, n) + soa + _pack_blobs(
+            self.payloads or [b""] * n)
+
+    @classmethod
+    def decode(cls, sender: int, n: int, body: memoryview) -> "AcceptBatch":
+        o = 0
+        gkey = np.frombuffer(body[o:o + 8 * n], np.uint64); o += 8 * n
+        slot = np.frombuffer(body[o:o + 4 * n], np.int32); o += 4 * n
+        bal = np.frombuffer(body[o:o + 4 * n], np.int32); o += 4 * n
+        rlo = np.frombuffer(body[o:o + 4 * n], np.int32); o += 4 * n
+        rhi = np.frombuffer(body[o:o + 4 * n], np.int32); o += 4 * n
+        blobs, _ = _unpack_blobs(body[o:], n)
+        return cls(sender, gkey, slot, bal, rlo, rhi, blobs)
+
+
+@dataclass
+class AcceptReplyBatch:
+    """Replica → coordinator: n accept replies.
+
+    Ref: ``paxospackets/AcceptReplyPacket`` + ``BatchedAcceptReply``.
+    ``bal`` is the accepted ballot on acks, the acceptor's promised ballot
+    on nacks (preemption signal).
+    """
+
+    sender: int
+    gkey: np.ndarray   # u64[n]
+    slot: np.ndarray   # i32[n]
+    bal: np.ndarray    # i32[n]
+    acked: np.ndarray  # u8[n]
+
+    TYPE = PacketType.ACCEPT_REPLY_BATCH
+
+    def encode(self) -> bytes:
+        n = len(self.gkey)
+        return (_HDR.pack(self.TYPE, self.sender, n) +
+                np.ascontiguousarray(self.gkey, np.uint64).tobytes() +
+                np.ascontiguousarray(self.slot, np.int32).tobytes() +
+                np.ascontiguousarray(self.bal, np.int32).tobytes() +
+                np.ascontiguousarray(self.acked, np.uint8).tobytes())
+
+    @classmethod
+    def decode(cls, sender, n, body) -> "AcceptReplyBatch":
+        o = 0
+        gkey = np.frombuffer(body[o:o + 8 * n], np.uint64); o += 8 * n
+        slot = np.frombuffer(body[o:o + 4 * n], np.int32); o += 4 * n
+        bal = np.frombuffer(body[o:o + 4 * n], np.int32); o += 4 * n
+        acked = np.frombuffer(body[o:o + n], np.uint8)
+        return cls(sender, gkey, slot, bal, acked)
+
+
+@dataclass
+class CommitBatch:
+    """Coordinator → replicas: n decisions (ids only; payloads already at
+    replicas from the accept; missing ones are fetched via SYNC).
+
+    Ref: ``PValuePacket`` decisions + ``BatchedCommit``.
+    """
+
+    sender: int
+    gkey: np.ndarray   # u64[n]
+    slot: np.ndarray   # i32[n]
+    bal: np.ndarray    # i32[n]
+    req_lo: np.ndarray  # i32[n]
+    req_hi: np.ndarray  # i32[n]
+
+    TYPE = PacketType.COMMIT_BATCH
+
+    def encode(self) -> bytes:
+        n = len(self.gkey)
+        return (_HDR.pack(self.TYPE, self.sender, n) +
+                np.ascontiguousarray(self.gkey, np.uint64).tobytes() +
+                np.ascontiguousarray(self.slot, np.int32).tobytes() +
+                np.ascontiguousarray(self.bal, np.int32).tobytes() +
+                np.ascontiguousarray(self.req_lo, np.int32).tobytes() +
+                np.ascontiguousarray(self.req_hi, np.int32).tobytes())
+
+    @classmethod
+    def decode(cls, sender, n, body) -> "CommitBatch":
+        o = 0
+        gkey = np.frombuffer(body[o:o + 8 * n], np.uint64); o += 8 * n
+        slot = np.frombuffer(body[o:o + 4 * n], np.int32); o += 4 * n
+        bal = np.frombuffer(body[o:o + 4 * n], np.int32); o += 4 * n
+        rlo = np.frombuffer(body[o:o + 4 * n], np.int32); o += 4 * n
+        rhi = np.frombuffer(body[o:o + 4 * n], np.int32); o += 4 * n
+        return cls(sender, gkey, slot, bal, rlo, rhi)
+
+
+# --------------------------------------------------------------------------
+# Scalar control-path packets (cold): simple struct encoding
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class Request:
+    """Client → entry replica (ref: ``RequestPacket``).  ``req_id`` is
+    globally unique: (client_id << 32 | seqno) by convention."""
+
+    sender: int
+    gkey: int
+    req_id: int
+    flags: int          # bit 0: stop request (group end-of-epoch)
+    payload: bytes
+
+    TYPE = PacketType.REQUEST
+    _S = struct.Struct("<QQB")
+    FLAG_STOP = 1
+
+    def encode(self) -> bytes:
+        return (_HDR.pack(self.TYPE, self.sender, 1) +
+                self._S.pack(self.gkey, self.req_id, self.flags) +
+                self.payload)
+
+    @classmethod
+    def decode(cls, sender, n, body) -> "Request":
+        gkey, req_id, flags = cls._S.unpack_from(body, 0)
+        return cls(sender, gkey, req_id, flags,
+                   bytes(body[cls._S.size:]))
+
+
+@dataclass
+class Response:
+    """Entry replica → client (executed result)."""
+
+    sender: int
+    gkey: int
+    req_id: int
+    status: int        # 0 ok; 1 not-coordinator/retry; 2 no-such-group
+    payload: bytes
+
+    TYPE = PacketType.RESPONSE
+    _S = struct.Struct("<QQB")
+
+    def encode(self) -> bytes:
+        return (_HDR.pack(self.TYPE, self.sender, 1) +
+                self._S.pack(self.gkey, self.req_id, self.status) +
+                self.payload)
+
+    @classmethod
+    def decode(cls, sender, n, body) -> "Response":
+        gkey, req_id, status = cls._S.unpack_from(body, 0)
+        return cls(sender, gkey, req_id, status, bytes(body[cls._S.size:]))
+
+
+@dataclass
+class Proposal:
+    """Replica → coordinator: forward a client request (ref:
+    ``ProposalPacket``).  ``entry`` remembers which replica owes the client
+    a response."""
+
+    sender: int
+    gkey: int
+    req_id: int
+    entry: int
+    flags: int
+    payload: bytes
+
+    TYPE = PacketType.PROPOSAL
+    _S = struct.Struct("<QQHB")
+
+    def encode(self) -> bytes:
+        return (_HDR.pack(self.TYPE, self.sender, 1) +
+                self._S.pack(self.gkey, self.req_id, self.entry,
+                             self.flags) + self.payload)
+
+    @classmethod
+    def decode(cls, sender, n, body) -> "Proposal":
+        gkey, req_id, entry, flags = cls._S.unpack_from(body, 0)
+        return cls(sender, gkey, req_id, entry, flags,
+                   bytes(body[cls._S.size:]))
+
+
+@dataclass
+class Prepare:
+    """Phase-1 (ref: ``PreparePacket``)."""
+
+    sender: int
+    gkey: int
+    bal: int
+
+    TYPE = PacketType.PREPARE
+    _S = struct.Struct("<Qi")
+
+    def encode(self) -> bytes:
+        return (_HDR.pack(self.TYPE, self.sender, 1) +
+                self._S.pack(self.gkey, self.bal))
+
+    @classmethod
+    def decode(cls, sender, n, body) -> "Prepare":
+        gkey, bal = cls._S.unpack_from(body, 0)
+        return cls(sender, gkey, bal)
+
+
+@dataclass
+class PrepareReply:
+    """Phase-1 reply carrying the accepted window ≥ exec_cursor, with
+    payloads so the new coordinator can re-propose (ref:
+    ``PrepareReplyPacket``)."""
+
+    sender: int
+    gkey: int
+    bal: int          # the prepare's ballot (ack) or promised (nack)
+    acked: bool
+    cursor: int
+    slots: np.ndarray     # i32[m]
+    bals: np.ndarray      # i32[m]
+    req_lo: np.ndarray    # i32[m]
+    req_hi: np.ndarray    # i32[m]
+    payloads: List[bytes] = field(default_factory=list)
+
+    TYPE = PacketType.PREPARE_REPLY
+    _S = struct.Struct("<QiBi")
+
+    def encode(self) -> bytes:
+        m = len(self.slots)
+        return (_HDR.pack(self.TYPE, self.sender, m) +
+                self._S.pack(self.gkey, self.bal, int(self.acked),
+                             self.cursor) +
+                np.ascontiguousarray(self.slots, np.int32).tobytes() +
+                np.ascontiguousarray(self.bals, np.int32).tobytes() +
+                np.ascontiguousarray(self.req_lo, np.int32).tobytes() +
+                np.ascontiguousarray(self.req_hi, np.int32).tobytes() +
+                _pack_blobs(self.payloads or [b""] * m))
+
+    @classmethod
+    def decode(cls, sender, n, body) -> "PrepareReply":
+        gkey, bal, acked, cursor = cls._S.unpack_from(body, 0)
+        o = cls._S.size
+        slots = np.frombuffer(body[o:o + 4 * n], np.int32); o += 4 * n
+        bals = np.frombuffer(body[o:o + 4 * n], np.int32); o += 4 * n
+        rlo = np.frombuffer(body[o:o + 4 * n], np.int32); o += 4 * n
+        rhi = np.frombuffer(body[o:o + 4 * n], np.int32); o += 4 * n
+        blobs, _ = _unpack_blobs(body[o:], n)
+        return cls(sender, gkey, bal, bool(acked), cursor, slots, bals,
+                   rlo, rhi, blobs)
+
+
+@dataclass
+class FailureDetect:
+    """Liveness ping/pong (ref: ``FailureDetectionPacket``)."""
+
+    sender: int
+    is_pong: int
+    ts_ns: int
+
+    TYPE = PacketType.FAILURE_DETECT
+    _S = struct.Struct("<BQ")
+
+    def encode(self) -> bytes:
+        return (_HDR.pack(self.TYPE, self.sender, 1) +
+                self._S.pack(self.is_pong, self.ts_ns))
+
+    @classmethod
+    def decode(cls, sender, n, body) -> "FailureDetect":
+        is_pong, ts = cls._S.unpack_from(body, 0)
+        return cls(sender, is_pong, ts)
+
+
+@dataclass
+class CreateGroup:
+    """Admin create (paxos-only mode; the reconfiguration layer wraps this;
+    ref: ``PaxosManager.createPaxosInstance``)."""
+
+    sender: int
+    name: str
+    members: Tuple[int, ...]
+    version: int
+    initial_state: bytes = b""
+
+    TYPE = PacketType.CREATE_GROUP
+
+    def encode(self) -> bytes:
+        nb = self.name.encode()
+        mem = np.asarray(self.members, np.int32).tobytes()
+        return (_HDR.pack(self.TYPE, self.sender, len(self.members)) +
+                struct.pack("<iH", self.version, len(nb)) + nb +
+                mem + self.initial_state)
+
+    @classmethod
+    def decode(cls, sender, n, body) -> "CreateGroup":
+        version, ln = struct.unpack_from("<iH", body, 0)
+        o = 6
+        name = bytes(body[o:o + ln]).decode(); o += ln
+        members = tuple(np.frombuffer(body[o:o + 4 * n], np.int32).tolist())
+        o += 4 * n
+        return cls(sender, name, members, version, bytes(body[o:]))
+
+
+@dataclass
+class CreateGroupAck:
+    sender: int
+    gkey: int
+    ok: int
+
+    TYPE = PacketType.CREATE_GROUP_ACK
+    _S = struct.Struct("<QB")
+
+    def encode(self) -> bytes:
+        return (_HDR.pack(self.TYPE, self.sender, 1) +
+                self._S.pack(self.gkey, self.ok))
+
+    @classmethod
+    def decode(cls, sender, n, body) -> "CreateGroupAck":
+        gkey, ok = cls._S.unpack_from(body, 0)
+        return cls(sender, gkey, ok)
+
+
+@dataclass
+class DeleteGroup:
+    sender: int
+    gkey: int
+    version: int
+
+    TYPE = PacketType.DELETE_GROUP
+    _S = struct.Struct("<Qi")
+
+    def encode(self) -> bytes:
+        return (_HDR.pack(self.TYPE, self.sender, 1) +
+                self._S.pack(self.gkey, self.version))
+
+    @classmethod
+    def decode(cls, sender, n, body) -> "DeleteGroup":
+        gkey, version = cls._S.unpack_from(body, 0)
+        return cls(sender, gkey, version)
+
+
+@dataclass
+class SyncRequest:
+    """Ask a peer for decisions in [from_slot, to_slot) of a group (gap
+    fill; ref: ``SyncDecisionsPacket``)."""
+
+    sender: int
+    gkey: int
+    from_slot: int
+    to_slot: int
+
+    TYPE = PacketType.SYNC_REQUEST
+    _S = struct.Struct("<Qii")
+
+    def encode(self) -> bytes:
+        return (_HDR.pack(self.TYPE, self.sender, 1) +
+                self._S.pack(self.gkey, self.from_slot, self.to_slot))
+
+    @classmethod
+    def decode(cls, sender, n, body) -> "SyncRequest":
+        gkey, f, t = cls._S.unpack_from(body, 0)
+        return cls(sender, gkey, f, t)
+
+
+@dataclass
+class SyncReply:
+    """Decisions + payloads for a gap (ref: decisions resent on sync)."""
+
+    sender: int
+    gkey: int
+    slots: np.ndarray
+    req_lo: np.ndarray
+    req_hi: np.ndarray
+    payloads: List[bytes] = field(default_factory=list)
+
+    TYPE = PacketType.SYNC_REPLY
+    _S = struct.Struct("<Q")
+
+    def encode(self) -> bytes:
+        m = len(self.slots)
+        return (_HDR.pack(self.TYPE, self.sender, m) +
+                self._S.pack(self.gkey) +
+                np.ascontiguousarray(self.slots, np.int32).tobytes() +
+                np.ascontiguousarray(self.req_lo, np.int32).tobytes() +
+                np.ascontiguousarray(self.req_hi, np.int32).tobytes() +
+                _pack_blobs(self.payloads or [b""] * m))
+
+    @classmethod
+    def decode(cls, sender, n, body) -> "SyncReply":
+        (gkey,) = cls._S.unpack_from(body, 0)
+        o = cls._S.size
+        slots = np.frombuffer(body[o:o + 4 * n], np.int32); o += 4 * n
+        rlo = np.frombuffer(body[o:o + 4 * n], np.int32); o += 4 * n
+        rhi = np.frombuffer(body[o:o + 4 * n], np.int32); o += 4 * n
+        blobs, _ = _unpack_blobs(body[o:], n)
+        return cls(sender, gkey, slots, rlo, rhi, blobs)
+
+
+@dataclass
+class CheckpointRequest:
+    sender: int
+    gkey: int
+
+    TYPE = PacketType.CHECKPOINT_REQUEST
+    _S = struct.Struct("<Q")
+
+    def encode(self) -> bytes:
+        return _HDR.pack(self.TYPE, self.sender, 1) + self._S.pack(self.gkey)
+
+    @classmethod
+    def decode(cls, sender, n, body) -> "CheckpointRequest":
+        (gkey,) = cls._S.unpack_from(body, 0)
+        return cls(sender, gkey)
+
+
+@dataclass
+class CheckpointReply:
+    sender: int
+    gkey: int
+    slot: int          # checkpoint is the app state AFTER executing `slot`
+    state: bytes
+
+    TYPE = PacketType.CHECKPOINT_REPLY
+    _S = struct.Struct("<Qi")
+
+    def encode(self) -> bytes:
+        return (_HDR.pack(self.TYPE, self.sender, 1) +
+                self._S.pack(self.gkey, self.slot) + self.state)
+
+    @classmethod
+    def decode(cls, sender, n, body) -> "CheckpointReply":
+        gkey, slot = cls._S.unpack_from(body, 0)
+        return cls(sender, gkey, slot, bytes(body[cls._S.size:]))
+
+
+# --------------------------------------------------------------------------
+# dispatch
+# --------------------------------------------------------------------------
+
+_DECODERS = {
+    PacketType.REQUEST: Request,
+    PacketType.RESPONSE: Response,
+    PacketType.PROPOSAL: Proposal,
+    PacketType.ACCEPT_BATCH: AcceptBatch,
+    PacketType.ACCEPT_REPLY_BATCH: AcceptReplyBatch,
+    PacketType.COMMIT_BATCH: CommitBatch,
+    PacketType.PREPARE: Prepare,
+    PacketType.PREPARE_REPLY: PrepareReply,
+    PacketType.FAILURE_DETECT: FailureDetect,
+    PacketType.CREATE_GROUP: CreateGroup,
+    PacketType.CREATE_GROUP_ACK: CreateGroupAck,
+    PacketType.DELETE_GROUP: DeleteGroup,
+    PacketType.SYNC_REQUEST: SyncRequest,
+    PacketType.SYNC_REPLY: SyncReply,
+    PacketType.CHECKPOINT_REQUEST: CheckpointRequest,
+    PacketType.CHECKPOINT_REPLY: CheckpointReply,
+}
+
+
+def decode(frame: bytes):
+    """Decode one frame (without the transport length prefix)."""
+    ptype, sender, n = _HDR.unpack_from(frame, 0)
+    cls = _DECODERS[PacketType(ptype)]
+    return cls.decode(sender, n, memoryview(frame)[_HDR.size:])

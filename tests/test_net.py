@@ -1,0 +1,256 @@
+"""L1 tests: packet codec round-trips + real-loopback-socket transport
+(the reference's test strategy: never mock the transport; SURVEY.md §4.6).
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from gigapaxos_tpu.paxos import packets as pk
+from gigapaxos_tpu.net.transport import Transport, Demultiplexer
+
+
+def _arr(vals, dt=np.int32):
+    return np.asarray(vals, dt)
+
+
+def test_hot_packet_roundtrips():
+    ab = pk.AcceptBatch(
+        sender=2, gkey=_arr([1, 2, 3], np.uint64), slot=_arr([0, 1, 2]),
+        bal=_arr([4096, 4096, 8192]), req_lo=_arr([7, 8, 9]),
+        req_hi=_arr([0, 0, 1]), payloads=[b"a", b"", b"ccc"])
+    d = pk.decode(ab.encode())
+    assert isinstance(d, pk.AcceptBatch) and d.sender == 2
+    np.testing.assert_array_equal(d.gkey, ab.gkey)
+    np.testing.assert_array_equal(d.slot, ab.slot)
+    np.testing.assert_array_equal(d.bal, ab.bal)
+    assert d.payloads == [b"a", b"", b"ccc"]
+
+    arb = pk.AcceptReplyBatch(
+        sender=1, gkey=_arr([5], np.uint64), slot=_arr([3]),
+        bal=_arr([4096]), acked=_arr([1], np.uint8))
+    d = pk.decode(arb.encode())
+    assert isinstance(d, pk.AcceptReplyBatch)
+    np.testing.assert_array_equal(d.acked, [1])
+
+    cb = pk.CommitBatch(
+        sender=0, gkey=_arr([5, 6], np.uint64), slot=_arr([3, 4]),
+        bal=_arr([0, 0]), req_lo=_arr([1, 2]), req_hi=_arr([0, 0]))
+    d = pk.decode(cb.encode())
+    assert isinstance(d, pk.CommitBatch)
+    np.testing.assert_array_equal(d.slot, [3, 4])
+
+
+def test_scalar_packet_roundtrips():
+    r = pk.Request(sender=1000, gkey=pk.group_key("svc0"), req_id=77,
+                   flags=pk.Request.FLAG_STOP, payload=b"hello")
+    d = pk.decode(r.encode())
+    assert (d.gkey, d.req_id, d.flags, d.payload) == (
+        r.gkey, 77, 1, b"hello")
+
+    resp = pk.Response(sender=0, gkey=3, req_id=77, status=0,
+                       payload=b"result")
+    d = pk.decode(resp.encode())
+    assert d.payload == b"result" and d.status == 0
+
+    prop = pk.Proposal(sender=1, gkey=9, req_id=5, entry=2, flags=0,
+                       payload=b"xyz")
+    d = pk.decode(prop.encode())
+    assert (d.entry, d.payload) == (2, b"xyz")
+
+    pr = pk.Prepare(sender=1, gkey=9, bal=8193)
+    d = pk.decode(pr.encode())
+    assert d.bal == 8193
+
+    prr = pk.PrepareReply(
+        sender=2, gkey=9, bal=8193, acked=True, cursor=4,
+        slots=_arr([4, 5]), bals=_arr([4096, 4096]),
+        req_lo=_arr([1, 2]), req_hi=_arr([0, 0]), payloads=[b"p4", b"p5"])
+    d = pk.decode(prr.encode())
+    assert d.acked and d.cursor == 4 and d.payloads == [b"p4", b"p5"]
+    np.testing.assert_array_equal(d.slots, [4, 5])
+
+    fd = pk.FailureDetect(sender=3, is_pong=1, ts_ns=123456789)
+    d = pk.decode(fd.encode())
+    assert d.is_pong == 1 and d.ts_ns == 123456789
+
+    cg = pk.CreateGroup(sender=0, name="svc0", members=(0, 1, 2),
+                        version=0, initial_state=b"init")
+    d = pk.decode(cg.encode())
+    assert d.name == "svc0" and d.members == (0, 1, 2)
+    assert d.initial_state == b"init"
+
+    ca = pk.CreateGroupAck(sender=1, gkey=12, ok=1)
+    assert pk.decode(ca.encode()).ok == 1
+
+    dg = pk.DeleteGroup(sender=1, gkey=12, version=3)
+    assert pk.decode(dg.encode()).version == 3
+
+    sr = pk.SyncRequest(sender=1, gkey=12, from_slot=3, to_slot=9)
+    d = pk.decode(sr.encode())
+    assert (d.from_slot, d.to_slot) == (3, 9)
+
+    sy = pk.SyncReply(sender=1, gkey=12, slots=_arr([3, 4]),
+                      req_lo=_arr([5, 6]), req_hi=_arr([0, 0]),
+                      payloads=[b"a", b"b"])
+    d = pk.decode(sy.encode())
+    assert d.payloads == [b"a", b"b"]
+
+    cr = pk.CheckpointRequest(sender=1, gkey=12)
+    assert pk.decode(cr.encode()).gkey == 12
+
+    cp = pk.CheckpointReply(sender=1, gkey=12, slot=400, state=b"snap")
+    d = pk.decode(cp.encode())
+    assert d.slot == 400 and d.state == b"snap"
+
+
+def test_group_key_stable():
+    assert pk.group_key("svc0") == pk.group_key("svc0")
+    assert pk.group_key("svc0") != pk.group_key("svc1")
+
+
+def test_demux_dispatch():
+    got = []
+    dm = Demultiplexer()
+    dm.register(pk.PacketType.PREPARE, lambda f: got.append(pk.decode(f)))
+    assert dm.dispatch(pk.Prepare(1, 9, 44).encode())
+    assert got[0].bal == 44
+    assert not dm.dispatch(pk.FailureDetect(0, 0, 1).encode())
+
+
+# --------------------------------------------------------------------------
+# transport on real loopback sockets
+# --------------------------------------------------------------------------
+
+
+async def _mk(node_id, addr_map, inbox):
+    t = Transport(node_id, ("127.0.0.1", 0), addr_map,
+                  on_frame=lambda f: inbox.append(pk.decode(f)))
+    await t.start()
+    return t
+
+
+async def _wait(cond, timeout=5.0):
+    t0 = asyncio.get_event_loop().time()
+    while not cond():
+        if asyncio.get_event_loop().time() - t0 > timeout:
+            raise TimeoutError
+        await asyncio.sleep(0.005)
+
+
+def test_transport_two_nodes():
+    async def main():
+        in0, in1 = [], []
+        t0 = await _mk(0, {}, in0)
+        t1 = await _mk(1, {0: ("127.0.0.1", t0.port)}, in1)
+        t1.addr_map[0] = ("127.0.0.1", t0.port)
+        t0.addr_map[1] = ("127.0.0.1", t1.port)
+
+        for k in range(50):
+            assert t1.send(0, pk.Prepare(1, k, k).encode())
+        await _wait(lambda: len(in0) == 50)
+        assert [p.gkey for p in in0] == list(range(50))
+        # reverse direction (separate connection)
+        t0.send(1, pk.FailureDetect(0, 0, 42).encode())
+        await _wait(lambda: len(in1) == 1)
+        assert in1[0].ts_ns == 42
+        assert t0.rcvd_frames == 50 and t0.sent_frames == 1
+        await t0.stop()
+        await t1.stop()
+
+    asyncio.run(main())
+
+
+def test_transport_client_reply_over_inbound():
+    """A 'client' (id not in the server's addr_map) sends a request; the
+    server replies over the same inbound connection (ClientMessenger
+    analog)."""
+    async def main():
+        server_in, client_in = [], []
+        srv = await _mk(0, {}, server_in)
+        cli = await _mk(1000, {0: ("127.0.0.1", srv.port)}, client_in)
+        cli.send(0, pk.Request(1000, 5, 1, 0, b"ping").encode())
+        await _wait(lambda: len(server_in) == 1)
+        assert srv.send(1000, pk.Response(0, 5, 1, 0, b"pong").encode())
+        await _wait(lambda: len(client_in) == 1)
+        assert client_in[0].payload == b"pong"
+        await srv.stop()
+        await cli.stop()
+
+    asyncio.run(main())
+
+
+def test_transport_queues_until_server_up():
+    """Frames queue through connect-retry and flush when the listener
+    appears (reconnect capability)."""
+    async def main():
+        inbox = []
+        # pick a port by binding a throwaway server, then closing it
+        tmp = await _mk(9, {}, [])
+        port = tmp.port
+        await tmp.stop()
+        await asyncio.sleep(0)
+
+        sender = await _mk(1, {0: ("127.0.0.1", port)}, [])
+        sender.send(0, pk.Prepare(1, 7, 7).encode())
+        await asyncio.sleep(0.1)  # retries happening, nothing listening
+
+        t0 = Transport(0, ("127.0.0.1", port), {},
+                       on_frame=lambda f: inbox.append(pk.decode(f)))
+        await t0.start()
+        await _wait(lambda: len(inbox) == 1, timeout=10)
+        assert inbox[0].gkey == 7
+        await sender.stop()
+        await t0.stop()
+
+    asyncio.run(main())
+
+
+def test_transport_congestion_drop():
+    async def main():
+        t = Transport(1, ("127.0.0.1", 0), {0: ("127.0.0.1", 1)},
+                      on_frame=lambda f: None, max_queue_bytes=64)
+        await t.start()
+        big = pk.Request(1, 1, 1, 0, b"x" * 100).encode()
+        assert not t.send(0, big)          # exceeds 64-byte budget
+        assert t.dropped_frames == 1
+        assert not t.send(55, b"zz")       # unknown destination
+        await t.stop()
+
+    asyncio.run(main())
+
+
+def test_transport_tls():
+    """SERVER_AUTH TLS with a self-signed cert (SSLDataProcessingWorker
+    analog)."""
+    import subprocess, tempfile, os
+    d = tempfile.mkdtemp()
+    cert, key = os.path.join(d, "c.pem"), os.path.join(d, "k.pem")
+    r = subprocess.run(
+        ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+         "-keyout", key, "-out", cert, "-days", "1", "-subj",
+         "/CN=localhost"], capture_output=True)
+    if r.returncode != 0:
+        pytest.skip("openssl unavailable")
+
+    from gigapaxos_tpu.net.transport import make_ssl_contexts
+    sctx, cctx = make_ssl_contexts(cert, key, cert)
+
+    async def main():
+        inbox = []
+        srv = Transport(0, ("127.0.0.1", 0), {},
+                        on_frame=lambda f: inbox.append(pk.decode(f)),
+                        ssl_server=sctx)
+        await srv.start()
+        cli = Transport(1, ("127.0.0.1", 0),
+                        {0: ("127.0.0.1", srv.port)},
+                        on_frame=lambda f: None, ssl_client=cctx)
+        await cli.start()
+        cli.send(0, pk.Prepare(1, 3, 3).encode())
+        await _wait(lambda: len(inbox) == 1)
+        assert inbox[0].gkey == 3
+        await cli.stop()
+        await srv.stop()
+
+    asyncio.run(main())
