@@ -165,6 +165,13 @@ class Transport:
         if w is None or w.is_closing():
             self.dropped_frames += 1
             return False
+        # backpressure: a stalled client must not grow server memory —
+        # consult the transport's write buffer against the same byte budget
+        if w.transport.get_write_buffer_size() + len(frame) > \
+                self.max_queue_bytes:
+            self.dropped_frames += 1
+            DelayProfiler.update_rate("net.drop")
+            return False
         self._write_frame(w, frame)
         return True
 

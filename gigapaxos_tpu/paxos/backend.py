@@ -317,11 +317,13 @@ class ScalarBackend(AcceptorBackend):
 # --------------------------------------------------------------------------
 
 
-def _bucket(n: int, lo: int = 8, hi: int = 1 << 16) -> int:
+def _bucket(n: int, lo: int = 8) -> int:
+    """Smallest power-of-two >= n (>= lo).  Unbounded: the number of jit
+    specializations grows with log2(max batch), not with batch count."""
     b = lo
     while b < n:
         b <<= 1
-    return min(b, hi)
+    return b
 
 
 class ColumnarBackend(AcceptorBackend):

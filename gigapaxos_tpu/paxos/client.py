@@ -1,0 +1,169 @@
+"""Async paxos client (ref: ``gigapaxos/PaxosClientAsync.java``).
+
+Capabilities kept: callback table keyed by request id (the reference's
+``GCConcurrentHashMap``), replica selection + failover to the next replica,
+retransmit on timeout, and a synchronous convenience wrapper.
+
+The client speaks the same framed wire protocol as servers; replies ride
+back over the client's own outbound connection (the transport's inbound
+reply path, ref ``ClientMessenger``).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import struct
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from gigapaxos_tpu.paxos import packets as pkt
+from gigapaxos_tpu.utils.logutil import get_logger
+
+log = get_logger("gp.client")
+
+_LEN = struct.Struct("<I")
+
+_client_seq = itertools.count(1)
+
+
+class PaxosClientAsync:
+    """Asyncio client: ``await send_request(name_or_gkey, payload)``."""
+
+    def __init__(self, client_id: int, servers: List[Tuple[str, int]],
+                 timeout: float = 5.0, retries: int = 3):
+        assert 0 < client_id < (1 << 31), \
+            "client id must fit the transport's signed-32 handshake"
+        self.id = client_id
+        self.servers = list(servers)
+        self.timeout = timeout
+        self.retries = retries
+        self._seq = itertools.count(1)
+        self._conns: Dict[int, Tuple[asyncio.StreamReader,
+                                     asyncio.StreamWriter]] = {}
+        self._read_tasks: Dict[int, asyncio.Task] = {}
+        self._waiting: Dict[int, asyncio.Future] = {}
+        self._preferred = 0
+
+    def next_req_id(self) -> int:
+        return (self.id << 32) | next(self._seq)
+
+    async def _conn(self, idx: int):
+        c = self._conns.get(idx)
+        if c is not None and not c[1].is_closing():
+            return c
+        host, port = self.servers[idx]
+        reader, writer = await asyncio.open_connection(host, port)
+        writer.write(_LEN.pack(4) + struct.pack("<i", self.id))
+        self._conns[idx] = (reader, writer)
+        t = asyncio.get_running_loop().create_task(self._read_loop(idx,
+                                                                   reader))
+        self._read_tasks[idx] = t
+        return reader, writer
+
+    async def _read_loop(self, idx: int, reader: asyncio.StreamReader):
+        try:
+            while True:
+                hdr = await reader.readexactly(4)
+                (ln,) = _LEN.unpack(hdr)
+                frame = await reader.readexactly(ln)
+                obj = pkt.decode(frame)
+                if isinstance(obj, (pkt.Response, pkt.CreateGroupAck)):
+                    rid = obj.req_id if isinstance(obj, pkt.Response) \
+                        else obj.gkey
+                    fut = self._waiting.pop(rid, None)
+                    if fut is not None and not fut.done():
+                        fut.set_result(obj)
+        except (asyncio.IncompleteReadError, ConnectionError, OSError,
+                asyncio.CancelledError):
+            self._conns.pop(idx, None)
+
+    async def send_request(self, name: str, payload: bytes,
+                           flags: int = 0) -> pkt.Response:
+        """Send to the preferred replica; on timeout retransmit (same id —
+        dedup is server-side) to the next replica."""
+        gkey = pkt.group_key(name)
+        req_id = self.next_req_id()
+        last_exc: Optional[Exception] = None
+        for attempt in range(self.retries + 1):
+            idx = (self._preferred + attempt) % len(self.servers)
+            try:
+                _, writer = await self._conn(idx)
+                fut = asyncio.get_running_loop().create_future()
+                self._waiting[req_id] = fut
+                frame = pkt.Request(self.id, gkey, req_id, flags,
+                                    payload).encode()
+                writer.write(_LEN.pack(len(frame)) + frame)
+                await writer.drain()
+                resp = await asyncio.wait_for(fut, self.timeout)
+                if resp.status == 0:
+                    self._preferred = idx
+                    return resp
+                last_exc = RuntimeError(f"status={resp.status}")
+            except (asyncio.TimeoutError, ConnectionError, OSError) as e:
+                last_exc = e
+            finally:
+                self._waiting.pop(req_id, None)
+        raise TimeoutError(
+            f"request {req_id:#x} to {name!r} failed: {last_exc}")
+
+    async def create_group(self, name: str, members: Tuple[int, ...],
+                           server_ids: List[int],
+                           initial_state: bytes = b"") -> bool:
+        """Paxos-only-mode create: instruct each listed server (by index
+        into ``self.servers``) to create the group locally (the harness /
+        reconfiguration path; ref ``PaxosManager.createPaxosInstance``)."""
+        oks = 0
+        for idx in server_ids:
+            _, writer = await self._conn(idx)
+            fut = asyncio.get_running_loop().create_future()
+            self._waiting[pkt.group_key(name)] = fut
+            frame = pkt.CreateGroup(self.id, name, members, 0,
+                                    initial_state).encode()
+            writer.write(_LEN.pack(len(frame)) + frame)
+            await writer.drain()
+            try:
+                ack = await asyncio.wait_for(fut, self.timeout)
+                oks += int(ack.ok)
+            except asyncio.TimeoutError:
+                pass
+        return oks == len(server_ids)
+
+    async def close(self):
+        for t in self._read_tasks.values():
+            t.cancel()
+        for _, w in self._conns.values():
+            w.close()
+        self._conns.clear()
+
+
+class PaxosClient:
+    """Blocking wrapper running its own event loop thread (test/harness
+    convenience; the reference's sync ``PaxosClient`` analog)."""
+
+    def __init__(self, servers: List[Tuple[str, int]],
+                 client_id: Optional[int] = None, timeout: float = 5.0):
+        self._loop = asyncio.new_event_loop()
+        self._thread = threading.Thread(target=self._loop.run_forever,
+                                        daemon=True, name="gp-client")
+        self._thread.start()
+        cid = client_id or (1000 + next(_client_seq))
+        self.async_client = PaxosClientAsync(cid, servers, timeout=timeout)
+
+    def _run(self, coro):
+        return asyncio.run_coroutine_threadsafe(coro, self._loop).result()
+
+    def send_request(self, name: str, payload: bytes,
+                     flags: int = 0) -> pkt.Response:
+        return self._run(self.async_client.send_request(name, payload,
+                                                        flags))
+
+    def create_group(self, name: str, members, server_ids,
+                     initial_state: bytes = b"") -> bool:
+        return self._run(self.async_client.create_group(
+            name, tuple(members), list(server_ids), initial_state))
+
+    def close(self):
+        self._run(self.async_client.close())
+        self._loop.call_soon_threadsafe(self._loop.stop)
+        self._thread.join(5)

@@ -113,6 +113,10 @@ class PaxosLogger:
         (ref: AbstractPaxosLogger.logBatch + group commit in
         SQLPaxosLogger)"""
         fut: Future = Future()
+        if self._closed:
+            # never hand out a future nobody will resolve (shutdown race)
+            fut.set_exception(RuntimeError("logger closed"))
+            return fut
         if not entries:
             fut.set_result(0)
             return fut
@@ -304,6 +308,15 @@ class PaxosLogger:
         self._closed = True
         self._q.put(None)
         self._writer.join(timeout=5)
+        # drain anything enqueued behind the sentinel: fail its futures
+        # rather than leaving callers blocked on .result() forever
+        try:
+            while True:
+                item = self._q.get_nowait()
+                if item is not None:
+                    item[1].set_exception(RuntimeError("logger closed"))
+        except queue.Empty:
+            pass
         self._wal.close()
         with self._db_lock:
             self._db.close()

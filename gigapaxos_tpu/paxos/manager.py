@@ -1,0 +1,913 @@
+"""PaxosNode: the node runtime (ref: ``gigapaxos/PaxosManager.java``).
+
+One ``PaxosNode`` is the analog of one ``PaxosManager`` + its
+``PaxosInstanceStateMachine``s: it owns the transport endpoint, the group
+table, the durable log, the payload store, and an :class:`AcceptorBackend`
+holding ALL groups' consensus state (columnar device arrays or scalar
+objects).  Where the reference dispatches each packet to a per-instance
+heap object, this runtime drains the demux queue into struct-of-arrays
+*kernel batches* (ref analog: ``PaxosPacketBatcher``) and drives whole
+batches through the backend — the north-star design (BASELINE.json).
+
+Pipeline (one worker iteration; SURVEY.md §3.1 hot path):
+
+    inq ─ drain ─> partition by type
+      REQUEST/PROPOSAL ──> backend.propose ──> AcceptBatch to members
+      ACCEPT_BATCH      ──> backend.accept ──> WAL fsync ──> AcceptReplyBatch
+      ACCEPT_REPLY      ──> backend.accept_reply ──> CommitBatch to members
+      COMMIT_BATCH      ──> backend.commit ──> in-order app.execute
+                             ──> Response to waiting clients, checkpoint cut
+
+Threading model: the asyncio loop thread owns sockets only; every frame is
+decoded and queued to the single *worker thread*, which owns the backend,
+the logger handles, and the app — the single-writer discipline that replaces
+the reference's per-instance synchronized blocks.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import queue as queue_mod
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+import numpy as np
+
+from gigapaxos_tpu.net.transport import Transport
+from gigapaxos_tpu.ops.types import (NO_BALLOT, NO_SLOT, pack_ballot,
+                                     unpack_ballot)
+from gigapaxos_tpu.paxos import packets as pkt
+from gigapaxos_tpu.paxos.backend import (AcceptorBackend, ColumnarBackend,
+                                         ScalarBackend)
+from gigapaxos_tpu.paxos.grouptable import GroupTable
+from gigapaxos_tpu.paxos.interfaces import Replicable
+from gigapaxos_tpu.paxos.logger import (CheckpointRec, LogEntry, PaxosLogger,
+                                        REC_ACCEPT, REC_DECIDE)
+from gigapaxos_tpu.paxos.paxosconfig import PC
+from gigapaxos_tpu.utils.config import Config
+from gigapaxos_tpu.utils.logutil import get_logger
+from gigapaxos_tpu.utils.profiler import DelayProfiler
+
+log = get_logger("gp.node")
+
+FLAG_STOP = 1
+FLAG_NOOP = 2
+
+
+@dataclass
+class _Election:
+    """Phase-1 bookkeeping at a would-be coordinator (host-side cold path;
+    ref: ``PaxosCoordinatorState`` prepare phase)."""
+
+    bal: int
+    started: float
+    acks: Set[int] = field(default_factory=set)
+    # slot -> (accepted ballot, req_id, flags, payload)
+    merged: Dict[int, Tuple[int, int, int, bytes]] = field(
+        default_factory=dict)
+    cursor: int = 0
+
+
+class PaxosNode:
+    """One replica node (server)."""
+
+    def __init__(self, node_id: int, addr_map: Dict[int, Tuple[str, int]],
+                 app: Replicable, logdir: str,
+                 backend: Optional[str] = None,
+                 capacity: Optional[int] = None,
+                 window: Optional[int] = None):
+        self.id = node_id
+        self.addr_map = dict(addr_map)
+        self.app = app
+        cap = capacity or Config.get(PC.CAPACITY)
+        win = window or Config.get(PC.WINDOW)
+        bk = backend or Config.get(PC.BACKEND)
+        self.backend: AcceptorBackend = (
+            ColumnarBackend(cap, win) if bk == "columnar"
+            else ScalarBackend(win))
+        self.table = GroupTable(cap)
+        self.logger = PaxosLogger(logdir, sync=bool(Config.get(PC.SYNC_WAL)))
+        self.batch_size = int(Config.get(PC.BATCH_SIZE))
+        self.batch_timeout = float(Config.get(PC.BATCH_TIMEOUT_S))
+        self.checkpoint_interval = int(Config.get(PC.CHECKPOINT_INTERVAL))
+
+        # host-side per-row mirrors (the cold scalar state the reference
+        # keeps in PaxosInstanceStateMachine fields)
+        self._bal_seen: Dict[int, int] = {}       # row -> max packed ballot
+        self._cursor: Dict[int, int] = {}         # row -> host exec cursor
+        self._dec: Dict[int, Dict[int, int]] = {}  # row -> slot -> req_id
+        self._ckpt_slot: Dict[int, int] = {}      # row -> last ckpt slot
+        # req_id -> (flags, payload); GC'd at local execution (§7.3.5)
+        self._payloads: Dict[int, Tuple[int, bytes]] = {}
+        # entry-replica reply table: req_id -> client node id
+        self._client_wait: Dict[int, int] = {}
+        # coordinator dedupe: req_id -> True while in flight
+        self._proposed: Set[int] = set()
+        self._elections: Dict[int, _Election] = {}
+
+        # failure detection (ref: gigapaxos/FailureDetection.java)
+        self._last_heard: Dict[int, float] = {}
+        self.ping_interval = float(Config.get(PC.PING_INTERVAL_S))
+        self.failure_timeout = float(Config.get(PC.FAILURE_TIMEOUT_S))
+
+        self._inq: "queue_mod.Queue" = queue_mod.Queue()
+        self._stopping = False
+        self.transport = Transport(
+            node_id, addr_map[node_id], addr_map, self._on_frame)
+        self._loop_thread: Optional[threading.Thread] = None
+        self._worker_thread: Optional[threading.Thread] = None
+        self._loop = None
+        self._started = threading.Event()
+
+        # counters
+        self.n_executed = 0
+        self.n_decided = 0
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+
+    def start(self) -> None:
+        """Boot: recover from the durable log, open sockets, start the
+        worker (ref: §3.2 boot & crash recovery)."""
+        self._recover()
+        import asyncio
+
+        def loop_main():
+            self._loop = asyncio.new_event_loop()
+            asyncio.set_event_loop(self._loop)
+            self._loop.run_until_complete(self.transport.start())
+            self._ping_task = self._loop.create_task(self._ping_loop())
+            self._started.set()
+            self._loop.run_forever()
+            # drain cancellations after stop()
+            self._loop.run_until_complete(self.transport.stop())
+            self._loop.close()
+
+        self._loop_thread = threading.Thread(
+            target=loop_main, daemon=True, name=f"gp-loop-{self.id}")
+        self._loop_thread.start()
+        self._started.wait(10)
+        self._worker_thread = threading.Thread(
+            target=self._worker_loop, daemon=True, name=f"gp-work-{self.id}")
+        self._worker_thread.start()
+
+    def stop(self) -> None:
+        self._stopping = True
+        self._inq.put(None)
+        if self._worker_thread:
+            self._worker_thread.join(5)
+        if self._loop:
+            self._loop.call_soon_threadsafe(self._ping_task.cancel)
+            self._loop.call_soon_threadsafe(self._loop.stop)
+            self._loop_thread.join(5)
+        self.logger.close()
+
+    @property
+    def port(self) -> int:
+        return self.transport.port
+
+    # ------------------------------------------------------------------
+    # group lifecycle (ref: PaxosManager.createPaxosInstance, §3.3)
+    # ------------------------------------------------------------------
+
+    def create_group(self, name: str, members: Tuple[int, ...],
+                     version: int = 0, initial_state: bytes = b"",
+                     durable: bool = True) -> bool:
+        """Local create (called by harness/reconfiguration on each member).
+        Initial coordinator is deterministic from the group key, and every
+        replica starts promised to it at ballot (0, coord) — so it safely
+        skips phase 1 (no prior accepts can exist)."""
+        if self.table.by_name(name) is not None:
+            return False
+        meta = self.table.create(name, members, version)
+        coord = members[meta.gkey % len(members)]
+        init_bal = pack_ballot(0, coord)
+        self.backend.create(
+            np.asarray([meta.row], np.int32),
+            np.asarray([len(members)], np.int32),
+            np.asarray([version], np.int32),
+            np.asarray([init_bal], np.int32),
+            np.asarray([coord == self.id]))
+        self._bal_seen[meta.row] = init_bal
+        self._cursor[meta.row] = 0
+        self._dec[meta.row] = {}
+        self._ckpt_slot[meta.row] = -1
+        if initial_state:
+            self.app.restore(name, initial_state)
+        if durable:
+            self.logger.put_group(meta.gkey, name, version, members)
+            self.logger.checkpoint(CheckpointRec(
+                meta.gkey, name, version, members, -1,
+                self.app.checkpoint(name)))
+        return True
+
+    def delete_group(self, name: str) -> bool:
+        meta = self.table.by_name(name)
+        if meta is None:
+            return False
+        self.backend.delete(np.asarray([meta.row], np.int32))
+        self.table.delete(meta.gkey)
+        for d in (self._bal_seen, self._cursor, self._dec, self._ckpt_slot):
+            d.pop(meta.row, None)
+        self._elections.pop(meta.row, None)
+        self.logger.delete_group(meta.gkey)
+        self.logger.delete_checkpoint(meta.gkey)
+        self.app.restore(meta.name, b"")
+        return True
+
+    # ------------------------------------------------------------------
+    # intake
+    # ------------------------------------------------------------------
+
+    def _on_frame(self, frame: bytes) -> None:
+        """Event-loop side: decode + hand off to the worker (the demux
+        thread-pool analog collapses to one hand-off queue)."""
+        obj = pkt.decode(frame)
+        self._inq.put(obj)
+
+    def _route(self, dst: int, obj) -> None:
+        """Send a packet object to ``dst``; self-sends loop back through
+        the worker queue without touching the wire."""
+        if dst == self.id:
+            self._inq.put(obj)
+        else:
+            self.transport.send_threadsafe(dst, obj.encode())
+
+    # ------------------------------------------------------------------
+    # worker
+    # ------------------------------------------------------------------
+
+    def _worker_loop(self) -> None:
+        while not self._stopping:
+            try:
+                first = self._inq.get(timeout=self.batch_timeout)
+            except queue_mod.Empty:
+                self._tick()
+                continue
+            if first is None:
+                break
+            batch = [first]
+            while len(batch) < self.batch_size:
+                try:
+                    nxt = self._inq.get_nowait()
+                except queue_mod.Empty:
+                    break
+                if nxt is None:
+                    self._stopping = True
+                    break
+                batch.append(nxt)
+            t0 = time.time()
+            try:
+                self._process(batch)
+            except Exception:
+                log.exception("worker batch failed (%d items)", len(batch))
+            DelayProfiler.update_delay("node.batch", t0, len(batch))
+            self._tick()
+
+    def _tick(self) -> None:
+        """Periodic duties: failure detection → run-for-coordinator."""
+        now = time.time()
+        if getattr(self, "_last_tick", 0) + self.ping_interval > now:
+            return
+        self._last_tick = now
+        dead = [n for n, t in self._last_heard.items()
+                if now - t > self.failure_timeout]
+        for n in dead:
+            self._on_node_dead(n)
+
+    # -- batch processing ----------------------------------------------
+
+    def _process(self, batch: List) -> None:
+        by_type: Dict[type, List] = {}
+        for obj in batch:
+            by_type.setdefault(type(obj), []).append(obj)
+            s = getattr(obj, "sender", None)
+            if s is not None and s in self.addr_map:
+                self._last_heard[s] = time.time()
+
+        # cold control path first (creates must precede traffic to them)
+        for o in by_type.pop(pkt.CreateGroup, []):
+            ok = self.create_group(o.name, o.members, o.version,
+                                   o.initial_state)
+            existing = self.table.by_name(o.name)
+            self._route(o.sender, pkt.CreateGroupAck(
+                self.id, existing.gkey if existing else 0,
+                1 if (ok or existing is not None) else 0))
+        for o in by_type.pop(pkt.DeleteGroup, []):
+            meta = self.table.by_key(o.gkey)
+            if meta is not None:
+                self.delete_group(meta.name)
+        for o in by_type.pop(pkt.FailureDetect, []):
+            if not o.is_pong:
+                self._route(o.sender, pkt.FailureDetect(self.id, 1, o.ts_ns))
+        for o in by_type.pop(pkt.SyncRequest, []):
+            self._handle_sync_request(o)
+        for o in by_type.pop(pkt.SyncReply, []):
+            self._handle_sync_reply(o)
+
+        # failover cold path
+        prepares = by_type.pop(pkt.Prepare, [])
+        if prepares:
+            self._handle_prepares(prepares)
+        for o in by_type.pop(pkt.PrepareReply, []):
+            self._handle_prepare_reply(o)
+
+        # hot path, pipeline order
+        reqs = by_type.pop(pkt.Request, [])
+        props = by_type.pop(pkt.Proposal, [])
+        if reqs or props:
+            self._handle_requests(reqs, props)
+        accepts = by_type.pop(pkt.AcceptBatch, [])
+        if accepts:
+            self._handle_accepts(accepts)
+        replies = by_type.pop(pkt.AcceptReplyBatch, [])
+        if replies:
+            self._handle_accept_replies(replies)
+        commits = by_type.pop(pkt.CommitBatch, [])
+        if commits:
+            self._handle_commits(commits)
+        for t, objs in by_type.items():
+            log.warning("unhandled packet type %s x%d", t.__name__,
+                        len(objs))
+
+    # -- request/proposal → propose ------------------------------------
+
+    def _handle_requests(self, reqs: List, props: List) -> None:
+        lanes: List[Tuple[int, int, int, bytes, int]] = []  # row,req,fl,pl,en
+        for o in reqs:
+            meta = self.table.by_key(o.gkey)
+            if meta is None:
+                self._route(o.sender, pkt.Response(
+                    self.id, o.gkey, o.req_id, 2, b""))
+                continue
+            self._client_wait[o.req_id] = o.sender
+            coord = unpack_ballot(self._bal_seen[meta.row])[1]
+            if coord != self.id:
+                self._route(coord, pkt.Proposal(
+                    self.id, o.gkey, o.req_id, o.sender, o.flags, o.payload))
+                continue
+            lanes.append((meta.row, o.req_id, o.flags, o.payload, o.sender))
+        for o in props:
+            meta = self.table.by_key(o.gkey)
+            if meta is None:
+                continue
+            coord = unpack_ballot(self._bal_seen[meta.row])[1]
+            if coord != self.id:
+                # not us (stale forward): bounce onward, bounded by TTL-less
+                # design — the client retries if it loops
+                if coord >= 0 and coord != o.sender:
+                    self._route(coord, o)
+                continue
+            lanes.append((meta.row, o.req_id, o.flags, o.payload, o.entry))
+        if not lanes:
+            return
+        # dedupe in-flight req_ids (client/proposal retransmits)
+        lanes = [l for l in lanes if l[1] not in self._proposed]
+        if not lanes:
+            return
+        rows = np.asarray([l[0] for l in lanes], np.int32)
+        req_ids = np.asarray([l[1] for l in lanes], np.uint64)
+        res = self.backend.propose(rows, req_ids)
+        for i, (row, req_id, flags, payload, entry) in enumerate(lanes):
+            if res.granted[i]:
+                self._proposed.add(req_id)
+                self._payloads.setdefault(req_id, (flags, payload))
+        self._emit_accepts(lanes, res)
+
+    def _emit_accepts(self, lanes, res) -> None:
+        """Granted lanes → AcceptBatch per member destination."""
+        by_dst: Dict[int, List[int]] = {}
+        metas = []
+        for i, (row, *_rest) in enumerate(lanes):
+            meta = self.table.by_row(row)
+            metas.append(meta)
+            if not res.granted[i] or meta is None:
+                continue
+            for m in meta.members:
+                by_dst.setdefault(m, []).append(i)
+        for dst, idxs in by_dst.items():
+            sel = lambda f: np.asarray([f(i) for i in idxs])
+            ab = pkt.AcceptBatch(
+                self.id,
+                sel(lambda i: metas[i].gkey).astype(np.uint64),
+                sel(lambda i: int(res.slot[i])).astype(np.int32),
+                sel(lambda i: int(res.cbal[i])).astype(np.int32),
+                *_split_reqs([lanes[i][1] for i in idxs]),
+                payloads=[bytes([lanes[i][2]]) + lanes[i][3] for i in idxs])
+            self._route(dst, ab)
+
+    # -- accepts (acceptor side) ---------------------------------------
+
+    def _handle_accepts(self, objs: List) -> None:
+        # flatten + coalesce: one lane per (row, slot), max ballot wins
+        best: Dict[Tuple[int, int], Tuple[int, int, int, bytes, int]] = {}
+        for o in objs:
+            pls = o.payloads or [b""] * len(o.gkey)
+            for j in range(len(o.gkey)):
+                meta = self.table.by_key(int(o.gkey[j]))
+                if meta is None:
+                    continue
+                key = (meta.row, int(o.slot[j]))
+                bal = int(o.bal[j])
+                if key not in best or bal > best[key][0]:
+                    req = _join_req(int(o.req_lo[j]), int(o.req_hi[j]))
+                    blob = pls[j]
+                    flags, payload = (blob[0], bytes(blob[1:])) if blob \
+                        else (0, b"")
+                    best[key] = (bal, req, flags, payload, o.sender)
+        if not best:
+            return
+        keys = list(best.keys())
+        rows = np.asarray([k[0] for k in keys], np.int32)
+        slots = np.asarray([k[1] for k in keys], np.int32)
+        bals = np.asarray([best[k][0] for k in keys], np.int32)
+        req_ids = np.asarray([best[k][1] for k in keys], np.uint64)
+        res = self.backend.accept(rows, slots, bals, req_ids)
+
+        entries = []
+        for i, k in enumerate(keys):
+            bal, req, flags, payload, sender = best[k]
+            if res.acked[i]:
+                self._payloads.setdefault(req, (flags, payload))
+                self._bal_seen[k[0]] = max(self._bal_seen.get(k[0],
+                                                             NO_BALLOT), bal)
+                entries.append(LogEntry(REC_ACCEPT, self.table.by_row(
+                    k[0]).gkey, k[1], bal, req, bytes([flags]) + payload))
+        # durability barrier: fsync BEFORE replies leave (SURVEY §7.3.2)
+        if entries:
+            self.logger.log_batch(entries).result()
+
+        # group replies per coordinator sender
+        by_coord: Dict[int, List[int]] = {}
+        for i, k in enumerate(keys):
+            if res.out_window[i]:
+                continue  # dropped; coordinator retries / window advances
+            by_coord.setdefault(best[k][4], []).append(i)
+        for dst, idxs in by_coord.items():
+            arb = pkt.AcceptReplyBatch(
+                self.id,
+                np.asarray([self.table.by_row(keys[i][0]).gkey
+                            for i in idxs], np.uint64),
+                np.asarray([keys[i][1] for i in idxs], np.int32),
+                np.asarray([int(best[keys[i]][0]) if res.acked[i]
+                            else int(res.cur_bal[i]) for i in idxs],
+                           np.int32),
+                np.asarray([1 if res.acked[i] else 0 for i in idxs],
+                           np.uint8))
+            self._route(dst, arb)
+
+    # -- accept replies (coordinator side) ------------------------------
+
+    def _handle_accept_replies(self, objs: List) -> None:
+        seen: Set[Tuple[int, int, int]] = set()
+        rows_l, slots_l, bals_l, senders_l, acked_l = [], [], [], [], []
+        for o in objs:
+            for j in range(len(o.gkey)):
+                meta = self.table.by_key(int(o.gkey[j]))
+                if meta is None:
+                    continue
+                key = (meta.row, int(o.slot[j]), o.sender)
+                if key in seen:
+                    continue
+                seen.add(key)
+                rows_l.append(meta.row)
+                slots_l.append(int(o.slot[j]))
+                bals_l.append(int(o.bal[j]))
+                senders_l.append(meta.members.index(o.sender)
+                                 if o.sender in meta.members else 0)
+                acked_l.append(bool(o.acked[j]))
+        if not rows_l:
+            return
+        res = self.backend.accept_reply(
+            np.asarray(rows_l, np.int32), np.asarray(slots_l, np.int32),
+            np.asarray(bals_l, np.int32), np.asarray(senders_l, np.int32),
+            np.asarray(acked_l))
+        # preemption: a higher ballot exists; adopt belief, stop leading
+        for i in range(len(rows_l)):
+            if res.preempted[i]:
+                self._bal_seen[rows_l[i]] = max(
+                    self._bal_seen.get(rows_l[i], NO_BALLOT), bals_l[i])
+        newly = [i for i in range(len(rows_l)) if res.newly_decided[i]]
+        if not newly:
+            return
+        self.n_decided += len(newly)
+        # decisions → CommitBatch to each member (incl. self via loopback)
+        by_dst: Dict[int, List[int]] = {}
+        for i in newly:
+            meta = self.table.by_row(rows_l[i])
+            for m in meta.members:
+                by_dst.setdefault(m, []).append(i)
+        for dst, idxs in by_dst.items():
+            cb = pkt.CommitBatch(
+                self.id,
+                np.asarray([self.table.by_row(rows_l[i]).gkey
+                            for i in idxs], np.uint64),
+                np.asarray([slots_l[i] for i in idxs], np.int32),
+                np.asarray([int(res.dec_bal[i]) for i in idxs], np.int32),
+                np.asarray([int(res.req_lo[i]) for i in idxs], np.int32),
+                np.asarray([int(res.req_hi[i]) for i in idxs], np.int32))
+            self._route(dst, cb)
+
+    # -- commits → execution -------------------------------------------
+
+    def _handle_commits(self, objs: List) -> None:
+        ded: Dict[Tuple[int, int], int] = {}
+        for o in objs:
+            for j in range(len(o.gkey)):
+                meta = self.table.by_key(int(o.gkey[j]))
+                if meta is None:
+                    continue
+                req = _join_req(int(o.req_lo[j]), int(o.req_hi[j]))
+                ded[(meta.row, int(o.slot[j]))] = req
+                self._bal_seen[meta.row] = max(
+                    self._bal_seen.get(meta.row, NO_BALLOT), int(o.bal[j]))
+        if not ded:
+            return
+        keys = list(ded.keys())
+        rows = np.asarray([k[0] for k in keys], np.int32)
+        slots = np.asarray([k[1] for k in keys], np.int32)
+        req_ids = np.asarray([ded[k] for k in keys], np.uint64)
+        res = self.backend.commit(rows, slots, req_ids)
+        self.logger.log_batch(
+            [LogEntry(REC_DECIDE, self.table.by_row(k[0]).gkey, k[1], 0,
+                      ded[k]) for i, k in enumerate(keys)
+             if res.applied[i]])  # decisions need not block on fsync
+        for i, k in enumerate(keys):
+            row, slot = k
+            if res.applied[i] or res.stale[i]:
+                self._dec[row][slot] = ded[k]
+        # execute newly contiguous decisions per touched row
+        for row in {k[0] for k in keys}:
+            self._execute_row(row)
+        # out-of-window commits: requeue once the window advances — here
+        # simply re-enqueue; window advance is driven by this same path
+        for i, k in enumerate(keys):
+            if res.out_window[i]:
+                self._sync_if_gap(k[0])
+
+    def _execute_row(self, row: int) -> None:
+        meta = self.table.by_row(row)
+        if meta is None:
+            return
+        cur = self._cursor.get(row, 0)
+        dec = self._dec[row]
+        while cur in dec:
+            req_id = dec.pop(cur)
+            flags, payload = self._payloads.pop(req_id, (None, b""))
+            if flags is None:
+                # we never saw the accept (gap): ask peers, stop here
+                dec[cur] = req_id
+                self._sync_if_gap(row)
+                break
+            if not (flags & FLAG_NOOP):
+                resp = self.app.execute(meta.name, req_id, payload,
+                                        bool(flags & FLAG_STOP))
+            else:
+                resp = b""
+            self.n_executed += 1
+            self._proposed.discard(req_id)
+            client = self._client_wait.pop(req_id, None)
+            if client is not None:
+                self._route(client, pkt.Response(
+                    self.id, meta.gkey, req_id, 0, resp))
+            cur += 1
+        self._cursor[row] = cur
+        # (device cursor advances in the commit kernel; no set_cursor here)
+        # checkpoint cut (ref: extractExecuteAndCheckpoint, every ~400)
+        last = self._ckpt_slot.get(row, -1)
+        if cur - 1 - last >= self.checkpoint_interval:
+            self._checkpoint_row(row, cur - 1)
+
+    def _checkpoint_row(self, row: int, upto_slot: int) -> None:
+        meta = self.table.by_row(row)
+        state = self.app.checkpoint(meta.name)
+        self.logger.checkpoint(CheckpointRec(
+            meta.gkey, meta.name, meta.version, meta.members, upto_slot,
+            state))
+        self._ckpt_slot[row] = upto_slot
+        self.backend.gc(np.asarray([row], np.int32),
+                        np.asarray([upto_slot], np.int32))
+
+    # -- sync (gap fill; ref: SyncDecisionsPacket) ----------------------
+
+    def _sync_if_gap(self, row: int) -> None:
+        now = time.time()
+        last = getattr(self, "_last_sync", {})
+        if last.get(row, 0) + 0.2 > now:
+            return
+        last[row] = now
+        self._last_sync = last
+        meta = self.table.by_row(row)
+        cur = self._cursor.get(row, 0)
+        coord = unpack_ballot(self._bal_seen.get(row, NO_BALLOT))[1]
+        dst = coord if (coord >= 0 and coord != self.id) else None
+        if dst is None:
+            others = [m for m in meta.members if m != self.id]
+            if not others:
+                return
+            dst = others[0]
+        self._route(dst, pkt.SyncRequest(self.id, meta.gkey, cur,
+                                         cur + self.backend.window))
+
+    def _handle_sync_request(self, o) -> None:
+        meta = self.table.by_key(o.gkey)
+        if meta is None:
+            return
+        row = meta.row
+        have = []
+        for s in range(o.from_slot, o.to_slot):
+            if s in self._dec.get(row, {}):
+                have.append((s, self._dec[row][s]))
+        # serve decisions we executed from the WAL-less hot mirror is not
+        # possible below cursor; offer a checkpoint instead
+        if not have and self._cursor.get(row, 0) > o.from_slot:
+            rec = self.logger.get_checkpoint(meta.gkey)
+            state = self.app.checkpoint(meta.name)
+            self._route(o.sender, pkt.CheckpointReply(
+                self.id, meta.gkey, self._cursor.get(row, 0) - 1, state))
+            return
+        if not have:
+            return
+        pls = []
+        for s, req in have:
+            fl, pl = self._payloads.get(req, (0, b""))
+            pls.append(bytes([fl]) + pl)
+        self._route(o.sender, pkt.SyncReply(
+            self.id, meta.gkey,
+            np.asarray([s for s, _ in have], np.int32),
+            *_split_reqs([req for _, req in have]), payloads=pls))
+
+    def _handle_sync_reply(self, o) -> None:
+        meta = self.table.by_key(o.gkey)
+        if meta is None:
+            return
+        pls = o.payloads or [b""] * len(o.slots)
+        ded = {}
+        for j in range(len(o.slots)):
+            req = _join_req(int(o.req_lo[j]), int(o.req_hi[j]))
+            blob = pls[j]
+            if blob:
+                self._payloads.setdefault(req, (blob[0], bytes(blob[1:])))
+            ded[(meta.row, int(o.slots[j]))] = req
+        if not ded:
+            return
+        keys = list(ded.keys())
+        res = self.backend.commit(
+            np.asarray([k[0] for k in keys], np.int32),
+            np.asarray([k[1] for k in keys], np.int32),
+            np.asarray([ded[k] for k in keys], np.uint64))
+        for i, k in enumerate(keys):
+            if res.applied[i] or res.stale[i]:
+                self._dec[k[0]][k[1]] = ded[k]
+        self._execute_row(meta.row)
+
+    # ------------------------------------------------------------------
+    # failover (ref: §3.5 coordinator failover)
+    # ------------------------------------------------------------------
+
+    def _on_node_dead(self, node: int) -> None:
+        """Scan groups whose believed coordinator is ``node``; if self is
+        next in line (deterministic order), run phase 1 for them."""
+        self._last_heard.pop(node, None)
+        log.info("node %d: peer %d suspected dead", self.id, node)
+        now = time.time()
+        for meta in list(self.table):
+            row = meta.row
+            bal = self._bal_seen.get(row, NO_BALLOT)
+            num, coord = unpack_ballot(bal)
+            if coord != node or self.id not in meta.members:
+                continue
+            # next-in-line: first live member after the dead coordinator in
+            # ring order (ref: deterministic from ballot/coordinator order)
+            order = list(meta.members)
+            start = (order.index(coord) + 1) % len(order)
+            nxt = None
+            for k in range(len(order)):
+                cand = order[(start + k) % len(order)]
+                if cand == node:
+                    continue
+                if cand == self.id or now - self._last_heard.get(
+                        cand, 0) <= self.failure_timeout:
+                    nxt = cand
+                    break
+            if nxt != self.id:
+                continue
+            self._start_election(row, meta)
+
+    def _start_election(self, row: int, meta) -> None:
+        num, _ = unpack_ballot(self._bal_seen.get(row, NO_BALLOT))
+        el = self._elections.get(row)
+        if el is not None and time.time() - el.started < 2.0:
+            return
+        bal = pack_ballot(num + 1, self.id)
+        self._elections[row] = _Election(bal=bal, started=time.time())
+        for m in meta.members:
+            self._route(m, pkt.Prepare(self.id, meta.gkey, bal))
+
+    def _handle_prepares(self, objs: List) -> None:
+        # coalesce to max ballot per row
+        best: Dict[int, Tuple[int, int]] = {}
+        for o in objs:
+            meta = self.table.by_key(o.gkey)
+            if meta is None:
+                continue
+            if meta.row not in best or o.bal > best[meta.row][0]:
+                best[meta.row] = (o.bal, o.sender)
+        if not best:
+            return
+        rows = list(best.keys())
+        res = self.backend.prepare(
+            np.asarray(rows, np.int32),
+            np.asarray([best[r][0] for r in rows], np.int32))
+        for i, row in enumerate(rows):
+            bal, sender = best[row]
+            meta = self.table.by_row(row)
+            self._bal_seen[row] = max(self._bal_seen.get(row, NO_BALLOT),
+                                      int(res.cur_bal[i]))
+            m = int(np.sum(res.win_slot[i] >= 0))
+            slots = res.win_slot[i][:m] if m else np.zeros(0, np.int32)
+            pls = []
+            for j in range(m):
+                req = _join_req(int(res.win_req_lo[i][j]),
+                                int(res.win_req_hi[i][j]))
+                fl, pl = self._payloads.get(req, (0, b""))
+                pls.append(bytes([fl]) + pl)
+            self._route(sender, pkt.PrepareReply(
+                self.id, meta.gkey, bal if res.acked[i]
+                else int(res.cur_bal[i]), bool(res.acked[i]),
+                int(res.exec_cursor[i]), slots,
+                res.win_bal[i][:m], res.win_req_lo[i][:m],
+                res.win_req_hi[i][:m], pls))
+
+    def _handle_prepare_reply(self, o) -> None:
+        meta = self.table.by_key(o.gkey)
+        if meta is None:
+            return
+        row = meta.row
+        el = self._elections.get(row)
+        if el is None:
+            return
+        if not o.acked:
+            if o.bal > el.bal:
+                self._bal_seen[row] = max(self._bal_seen.get(row, NO_BALLOT),
+                                          o.bal)
+                del self._elections[row]
+            return
+        if o.bal != el.bal:
+            return
+        el.acks.add(o.sender)
+        el.cursor = max(el.cursor, o.cursor)
+        pls = o.payloads or [b""] * len(o.slots)
+        for j in range(len(o.slots)):
+            s = int(o.slots[j])
+            b = int(o.bals[j])
+            req = _join_req(int(o.req_lo[j]), int(o.req_hi[j]))
+            blob = pls[j]
+            fl, pl = (blob[0], bytes(blob[1:])) if blob else (0, b"")
+            if s not in el.merged or b > el.merged[s][0]:
+                el.merged[s] = (b, req, fl, pl)
+        if len(el.acks) < len(meta.members) // 2 + 1:
+            return
+        # majority: install + re-propose carryover, fill holes with noops
+        del self._elections[row]
+        self._install_as_coordinator(row, meta, el)
+
+    def _install_as_coordinator(self, row: int, meta, el: _Election) -> None:
+        cursor = max(el.cursor, self._cursor.get(row, 0))
+        carry = {s: v for s, v in el.merged.items() if s >= cursor}
+        top = max(carry.keys(), default=cursor - 1)
+        # holes become noops (classic multipaxos hole fill)
+        for s in range(cursor, top + 1):
+            if s not in carry:
+                noop_req = (1 << 63) | (meta.gkey & 0x7FFFFFFF00000000) | s
+                carry[s] = (el.bal, noop_req, FLAG_NOOP, b"")
+        next_slot = top + 1
+        W = self.backend.window
+        cs = np.full((1, W), NO_SLOT, np.int32)
+        cr = np.zeros((1, W), np.uint64)
+        for j, s in enumerate(sorted(carry.keys())[:W]):
+            cs[0, j] = s
+            cr[0, j] = carry[s][1]
+        self.backend.install_coordinator(
+            np.asarray([row], np.int32), np.asarray([el.bal], np.int32),
+            np.asarray([next_slot], np.int32), cs, cr)
+        self._bal_seen[row] = el.bal
+        log.info("node %d now coordinator of %s at bal %d (carry %d)",
+                 self.id, meta.name, el.bal, len(carry))
+        # re-propose carryover pvalues at our ballot
+        if carry:
+            for m in meta.members:
+                items = sorted(carry.items())
+                self._route(m, pkt.AcceptBatch(
+                    self.id,
+                    np.asarray([meta.gkey] * len(items), np.uint64),
+                    np.asarray([s for s, _ in items], np.int32),
+                    np.asarray([el.bal] * len(items), np.int32),
+                    *_split_reqs([v[1] for _, v in items]),
+                    payloads=[bytes([v[2]]) + v[3] for _, v in items]))
+
+    # ------------------------------------------------------------------
+    # failure-detection ping task (event loop side)
+    # ------------------------------------------------------------------
+
+    async def _ping_loop(self):
+        import asyncio
+        import time as _t
+        while True:
+            await asyncio.sleep(self.ping_interval)
+            for n in self.addr_map:
+                if n == self.id:
+                    continue
+                self.transport.send(n, pkt.FailureDetect(
+                    self.id, 0, _t.time_ns()).encode())
+
+    # ------------------------------------------------------------------
+    # recovery (ref: §3.2)
+    # ------------------------------------------------------------------
+
+    def _recover(self) -> None:
+        groups = self.logger.all_groups()
+        if not groups:
+            return
+        t0 = time.time()
+        for gkey, name, version, members in groups:
+            meta_exists = self.table.by_key(gkey)
+            if meta_exists:
+                continue
+            meta = self.table.create(name, members, version)
+            coord = members[gkey % len(members)]
+            init_bal = pack_ballot(0, coord)
+            self.backend.create(
+                np.asarray([meta.row], np.int32),
+                np.asarray([len(members)], np.int32),
+                np.asarray([version], np.int32),
+                np.asarray([init_bal], np.int32),
+                np.asarray([False]))  # NEVER coordinator on restart until
+            self._bal_seen[meta.row] = init_bal  # re-elected (safe default)
+            self._cursor[meta.row] = 0
+            self._dec[meta.row] = {}
+            self._ckpt_slot[meta.row] = -1
+            rec = self.logger.get_checkpoint(gkey)
+            if rec is not None and rec.slot >= 0:
+                self.app.restore(name, rec.state)
+                self._cursor[meta.row] = rec.slot + 1
+                self._ckpt_slot[meta.row] = rec.slot
+                self.backend.set_cursor(
+                    np.asarray([meta.row], np.int32),
+                    np.asarray([rec.slot + 1], np.int32),
+                    np.asarray([rec.slot + 1], np.int32))
+            elif rec is not None:
+                self.app.restore(name, rec.state)
+        # roll forward the WAL (accepts re-promise; decisions re-execute)
+        acc_rows, acc_slots, acc_bals, acc_reqs = [], [], [], []
+        dec_by_row: Dict[int, Dict[int, int]] = {}
+        for e in self.logger.read_wal():
+            meta = self.table.by_key(e.gkey)
+            if meta is None:
+                continue
+            if e.rtype == REC_ACCEPT:
+                acc_rows.append(meta.row)
+                acc_slots.append(e.slot)
+                acc_bals.append(e.bal)
+                acc_reqs.append(e.req_id)
+                if e.payload:
+                    self._payloads.setdefault(
+                        e.req_id, (e.payload[0], bytes(e.payload[1:])))
+                self._bal_seen[meta.row] = max(
+                    self._bal_seen.get(meta.row, NO_BALLOT), e.bal)
+            else:
+                dec_by_row.setdefault(meta.row, {})[e.slot] = e.req_id
+        if acc_rows:
+            self.backend.accept(
+                np.asarray(acc_rows, np.int32),
+                np.asarray(acc_slots, np.int32),
+                np.asarray(acc_bals, np.int32),
+                np.asarray(acc_reqs, np.uint64))
+        if dec_by_row:
+            keys = [(r, s) for r, d in dec_by_row.items() for s in d]
+            res = self.backend.commit(
+                np.asarray([k[0] for k in keys], np.int32),
+                np.asarray([k[1] for k in keys], np.int32),
+                np.asarray([dec_by_row[k[0]][k[1]] for k in keys],
+                           np.uint64))
+            for i, (r, s) in enumerate(keys):
+                if res.applied[i] or res.stale[i]:
+                    if s >= self._cursor.get(r, 0):
+                        self._dec[r][s] = dec_by_row[r][s]
+            for r in dec_by_row:
+                self._execute_row(r)
+        log.info("node %d recovered %d groups in %.3fs", self.id,
+                 len(groups), time.time() - t0)
+
+
+def _split_reqs(reqs: List[int]) -> Tuple[np.ndarray, np.ndarray]:
+    arr = np.asarray(reqs, np.uint64)
+    lo = (arr & np.uint64(0xFFFFFFFF)).astype(np.uint32).view(np.int32)
+    hi = (arr >> np.uint64(32)).astype(np.uint32).view(np.int32)
+    return lo, hi
+
+
+def _join_req(lo: int, hi: int) -> int:
+    return (lo & 0xFFFFFFFF) | ((hi & 0xFFFFFFFF) << 32)

@@ -22,7 +22,7 @@ create — the analog of the reference's paxosID string interning via
 
 Frame layout (after the transport's length prefix)::
 
-    u8 type | u16 sender | u32 n_items | fixed SoA arrays | blob section
+    u8 type | u32 sender | u32 n_items | fixed SoA arrays | blob section
 
 Blob section: ``u32 total | n× (u32 off)`` then concatenated bytes — blobs
 are optional per type.
@@ -66,7 +66,8 @@ class PacketType(IntEnum):
     CHECKPOINT_REPLY = 16
 
 
-_HDR = struct.Struct("<BHI")  # type, sender, n_items
+_HDR = struct.Struct("<BII")  # type, sender (u32, matches the transport's
+# 32-bit id handshake space), n_items
 
 
 def _pack_blobs(blobs: Sequence[bytes]) -> bytes:

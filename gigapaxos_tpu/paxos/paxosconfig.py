@@ -1,0 +1,34 @@
+"""Core paxos knobs (ref: ``gigapaxos/PaxosConfig.java`` ``PC`` enum).
+
+Enum-keyed with typed defaults; overridable via properties file
+(``GP_CONFIG=...``), ``GP_*`` env vars, or programmatic ``Config.set``
+(layering per ``utils/Config.java``).
+"""
+
+from __future__ import annotations
+
+from gigapaxos_tpu.utils.config import ConfigKey
+
+
+class PC(ConfigKey):
+    """Paxos-core config keys; member value = typed code default."""
+
+    # group capacity of the columnar state (rows in [G, W] device arrays)
+    CAPACITY = 1 << 17
+    # slot window per group (W); also the max in-flight slots per group
+    WINDOW = 16
+    # max packet lanes per kernel batch drained from the demux queue
+    BATCH_SIZE = 4096
+    # batch-fill timeout: flush a partial batch after this many seconds
+    BATCH_TIMEOUT_S = 0.002
+    # app checkpoint every this many slots per group (ref ~400)
+    CHECKPOINT_INTERVAL = 400
+    # backend: "columnar" (JAX/TPU) or "scalar" (per-instance baseline)
+    BACKEND = "columnar"
+    # fsync WAL batches before acking accepts (the durability contract)
+    SYNC_WAL = True
+    # failure detection
+    PING_INTERVAL_S = 0.5
+    FAILURE_TIMEOUT_S = 3.0
+    # max requests outstanding per client connection before pushback
+    CLIENT_MAX_OUTSTANDING = 8192
