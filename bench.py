@@ -1,0 +1,132 @@
+#!/usr/bin/env python
+"""North-star benchmark: paxos decisions/sec at 1M groups (BASELINE.json
+config 3: "1M groups, batched AcceptPacket storms").
+
+Columnar side: the fused decide-storm step (propose → accept×3 →
+accept_reply×3 → commit×3, one XLA program) over [G, W] device arrays.
+Baseline side: the same logical pipeline through ``ScalarBackend`` — the
+per-instance Python stand-in for the reference's per-instance Java path
+(``PaxosManager`` → heap ``PaxosInstanceStateMachine``), measured on a
+sample and reported as decisions/sec.
+
+Prints ONE json line:
+  {"metric": ..., "value": N, "unit": "decisions/s", "vs_baseline": N}
+"""
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def bench_columnar(G: int, W: int, B: int, iters: int, warmup: int):
+    import jax
+    from gigapaxos_tpu.ops.storm import make_fleet, storm
+
+    rng = np.random.default_rng(0)
+    t0 = time.time()
+    states = make_fleet(G, W, R=3)
+    jax.block_until_ready(states[0].bal)
+    t_fleet = time.time() - t0
+
+    def step(states):
+        g = jax.numpy.asarray(rng.integers(0, G, B, dtype=np.int32))
+        rlo = jax.numpy.asarray(
+            rng.integers(0, 1 << 31, B, dtype=np.int32))
+        rhi = jax.numpy.asarray(
+            rng.integers(0, 1 << 31, B, dtype=np.int32))
+        valid = jax.numpy.ones((B,), bool)
+        return storm(states, g, rlo, rhi, valid)
+
+    t0 = time.time()
+    for _ in range(warmup):
+        states, n = step(states)
+    n.block_until_ready()
+    t_compile = time.time() - t0
+
+    decided = 0
+    t0 = time.time()
+    for _ in range(iters):
+        states, n = step(states)
+        decided += int(n)  # sync point each iter (host reads the count)
+    dt = time.time() - t0
+    return decided / dt, dict(fleet_s=round(t_fleet, 1),
+                              warm_s=round(t_compile, 1),
+                              decided=decided, wall_s=round(dt, 2))
+
+
+def bench_scalar(G: int, W: int, B: int, iters: int):
+    """Per-instance baseline on a G-group fleet (sampled smaller for
+    runtime sanity; per-decision cost is group-count independent in this
+    regime — dict lookups)."""
+    from gigapaxos_tpu.paxos.backend import ScalarBackend
+
+    rng = np.random.default_rng(1)
+    backends = [ScalarBackend(W) for _ in range(3)]
+    rows = np.arange(G, dtype=np.int32)
+    for r, b in enumerate(backends):
+        b.create(rows, np.full(G, 3, np.int32), np.zeros(G, np.int32),
+                 np.zeros(G, np.int32), np.full(G, r == 0))
+    decided = 0
+    t0 = time.time()
+    for _ in range(iters):
+        g = rng.integers(0, G, B, dtype=np.int32)
+        reqs = rng.integers(1, 1 << 62, B, dtype=np.uint64)
+        pr = backends[0].propose(g, reqs)
+        acks = []
+        for b in backends:
+            ar = b.accept(g, pr.slot, pr.cbal, reqs)
+            acks.append(ar.acked & pr.granted)
+        newly = np.zeros(B, bool)
+        for s, b in enumerate(backends):
+            rr = backends[0].accept_reply(
+                g, pr.slot, pr.cbal, np.full(B, s, np.int32), acks[s])
+            newly |= rr.newly_decided
+        for b in backends:
+            b.commit(g, pr.slot, reqs)
+        decided += int(newly.sum())
+    dt = time.time() - t0
+    return decided / dt
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--groups", type=int, default=1 << 20)
+    p.add_argument("--window", type=int, default=16)
+    p.add_argument("--batch", type=int, default=1 << 18)
+    p.add_argument("--iters", type=int, default=20)
+    p.add_argument("--warmup", type=int, default=2)
+    p.add_argument("--baseline-groups", type=int, default=1 << 14)
+    p.add_argument("--baseline-batch", type=int, default=1 << 13)
+    p.add_argument("--baseline-iters", type=int, default=4)
+    p.add_argument("--quick", action="store_true",
+                   help="small shapes (CI / smoke)")
+    args = p.parse_args()
+    if args.quick:
+        args.groups, args.batch, args.iters = 1 << 14, 1 << 12, 5
+        args.baseline_groups, args.baseline_batch = 1 << 12, 1 << 11
+        args.baseline_iters = 2
+
+    cps, info = bench_columnar(args.groups, args.window, args.batch,
+                               args.iters, args.warmup)
+    sps = bench_scalar(args.baseline_groups, args.window,
+                       args.baseline_batch, args.baseline_iters)
+    import jax
+    info.update(platform=jax.devices()[0].platform,
+                scalar_baseline_dps=round(sps),
+                groups=args.groups, batch=args.batch)
+    print(json.dumps({
+        "metric": f"paxos decisions/sec @ {args.groups} groups "
+                  "(batched accept storms, 3 replicas)",
+        "value": round(cps),
+        "unit": "decisions/s",
+        "vs_baseline": round(cps / sps, 2) if sps else None,
+        "info": info,
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

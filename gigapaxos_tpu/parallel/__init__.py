@@ -1,0 +1,14 @@
+"""Multi-chip parallelism: sharding the group dimension over a device mesh.
+
+SURVEY.md §2.7: the reference's scale axis is *groups* (millions of
+independent RSMs) — the data-parallel analog.  Here that axis is sharded
+over TPU cores with ``NamedSharding(mesh, P('groups'))``; XLA inserts the
+ICI collectives implied by cross-shard gathers/scatters.
+"""
+
+from gigapaxos_tpu.parallel.sharding import (make_group_mesh,
+                                             make_sharded_storm,
+                                             shard_fleet, state_sharding)
+
+__all__ = ["make_group_mesh", "make_sharded_storm", "shard_fleet",
+           "state_sharding"]

@@ -1,0 +1,68 @@
+"""Group-axis sharding of the columnar state over a jax Mesh.
+
+Design (SURVEY.md §2.7, "TPU-native equivalent" column): every per-group
+array (``[G]`` or ``[G, W]``) is sharded on its leading (group) axis; batch
+lanes stay replicated.  Kernel gathers/scatters address *global* row
+indices, so under jit XLA's SPMD partitioner turns them into shard-local
+ops plus the minimal ICI collectives — no hand-written collective calls,
+exactly the pjit recipe (scaling-book style: pick a mesh, annotate
+shardings, let XLA insert collectives).
+
+The batch→shard routing that a production multi-chip deployment would do
+on the host (bucket packet lanes by ``row // rows_per_shard``) is
+deliberately NOT needed for correctness here — XLA masks out-of-shard
+lanes — it is a later throughput optimization.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from gigapaxos_tpu.ops.storm import decide_storm_step
+from gigapaxos_tpu.ops.types import ColumnarState
+
+GROUP_AXIS = "groups"
+
+
+def make_group_mesh(n_devices: Optional[int] = None) -> Mesh:
+    """1-D mesh over the first ``n_devices`` (default: all)."""
+    devs = jax.devices()
+    if n_devices is not None:
+        if len(devs) < n_devices:
+            raise ValueError(
+                f"need {n_devices} devices, have {len(devs)} "
+                "(set XLA_FLAGS=--xla_force_host_platform_device_count=N)")
+        devs = devs[:n_devices]
+    return Mesh(np.asarray(devs), (GROUP_AXIS,))
+
+
+def state_sharding(mesh: Mesh) -> ColumnarState:
+    """Pytree of NamedShardings: every state field sharded on axis 0."""
+    ns = NamedSharding(mesh, P(GROUP_AXIS))
+    return jax.tree_util.tree_map(lambda _: ns, ColumnarState(
+        *ColumnarState._fields))
+
+
+def shard_fleet(states: Tuple[ColumnarState, ...], mesh: Mesh
+                ) -> Tuple[ColumnarState, ...]:
+    """Move replica states onto the mesh, group-axis sharded."""
+    ns = NamedSharding(mesh, P(GROUP_AXIS))
+    return jax.tree_util.tree_map(
+        lambda a: jax.device_put(a, ns), states)
+
+
+def make_sharded_storm(mesh: Mesh, n_replicas: int = 3):
+    """The full decide-storm step jitted with explicit shardings: states
+    sharded over ``groups``, batch lanes replicated, outputs sharded the
+    same way (state stays resident; only the decided count is pulled)."""
+    st_sh = tuple(state_sharding(mesh) for _ in range(n_replicas))
+    repl = NamedSharding(mesh, P())
+    return jax.jit(
+        decide_storm_step,
+        in_shardings=(st_sh, repl, repl, repl, repl),
+        out_shardings=(st_sh, repl),
+        donate_argnums=0)

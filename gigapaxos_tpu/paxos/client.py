@@ -114,10 +114,11 @@ class PaxosClientAsync:
         into ``self.servers``) to create the group locally (the harness /
         reconfiguration path; ref ``PaxosManager.createPaxosInstance``)."""
         oks = 0
+        gkey = pkt.group_key(name)
         for idx in server_ids:
             _, writer = await self._conn(idx)
             fut = asyncio.get_running_loop().create_future()
-            self._waiting[pkt.group_key(name)] = fut
+            self._waiting[gkey] = fut
             frame = pkt.CreateGroup(self.id, name, members, 0,
                                     initial_state).encode()
             writer.write(_LEN.pack(len(frame)) + frame)
@@ -127,6 +128,8 @@ class PaxosClientAsync:
                 oks += int(ack.ok)
             except asyncio.TimeoutError:
                 pass
+            finally:
+                self._waiting.pop(gkey, None)
         return oks == len(server_ids)
 
     async def close(self):

@@ -53,6 +53,10 @@ log = get_logger("gp.node")
 
 FLAG_STOP = 1
 FLAG_NOOP = 2
+# payload unknown to the sender of this pvalue (prepare-reply carryover
+# only): receivers keep their own copy if they have one; executors treat a
+# still-missing payload as a gap and sync — never fabricate an empty one
+FLAG_MISSING = 4
 
 
 @dataclass
@@ -227,13 +231,22 @@ class PaxosNode:
         obj = pkt.decode(frame)
         self._inq.put(obj)
 
+    def _store_payload(self, req: int, flags: int, payload: bytes) -> None:
+        """Keep the best copy: a real payload always beats a FLAG_MISSING
+        placeholder, regardless of arrival order."""
+        cur = self._payloads.get(req)
+        if cur is None or ((cur[0] & FLAG_MISSING)
+                           and not (flags & FLAG_MISSING)):
+            self._payloads[req] = (flags, payload)
+
     def _route(self, dst: int, obj) -> None:
         """Send a packet object to ``dst``; self-sends loop back through
         the worker queue without touching the wire."""
         if dst == self.id:
             self._inq.put(obj)
-        else:
+        elif self._loop is not None:
             self.transport.send_threadsafe(dst, obj.encode())
+        # else: recovery runs before sockets exist; peers re-sync later
 
     # ------------------------------------------------------------------
     # worker
@@ -258,7 +271,7 @@ class PaxosNode:
                     self._stopping = True
                     break
                 batch.append(nxt)
-            t0 = time.time()
+            t0 = time.monotonic()
             try:
                 self._process(batch)
             except Exception:
@@ -267,7 +280,14 @@ class PaxosNode:
             self._tick()
 
     def _tick(self) -> None:
-        """Periodic duties: failure detection → run-for-coordinator."""
+        """Periodic duties: failure detection → run-for-coordinator.
+        Exception-guarded: a failover-path bug must not kill the worker."""
+        try:
+            self._tick_inner()
+        except Exception:
+            log.exception("tick failed")
+
+    def _tick_inner(self) -> None:
         now = time.time()
         if getattr(self, "_last_tick", 0) + self.ping_interval > now:
             return
@@ -306,6 +326,15 @@ class PaxosNode:
             self._handle_sync_request(o)
         for o in by_type.pop(pkt.SyncReply, []):
             self._handle_sync_reply(o)
+        for o in by_type.pop(pkt.CheckpointRequest, []):
+            meta = self.table.by_key(o.gkey)
+            if meta is not None:
+                self._route(o.sender, pkt.CheckpointReply(
+                    self.id, meta.gkey,
+                    self._cursor.get(meta.row, 0) - 1,
+                    self.app.checkpoint(meta.name)))
+        for o in by_type.pop(pkt.CheckpointReply, []):
+            self._handle_checkpoint_reply(o)
 
         # failover cold path
         prepares = by_type.pop(pkt.Prepare, [])
@@ -373,7 +402,7 @@ class PaxosNode:
         for i, (row, req_id, flags, payload, entry) in enumerate(lanes):
             if res.granted[i]:
                 self._proposed.add(req_id)
-                self._payloads.setdefault(req_id, (flags, payload))
+                self._store_payload(req_id, flags, payload)
         self._emit_accepts(lanes, res)
 
     def _emit_accepts(self, lanes, res) -> None:
@@ -430,7 +459,7 @@ class PaxosNode:
         for i, k in enumerate(keys):
             bal, req, flags, payload, sender = best[k]
             if res.acked[i]:
-                self._payloads.setdefault(req, (flags, payload))
+                self._store_payload(req, flags, payload)
                 self._bal_seen[k[0]] = max(self._bal_seen.get(k[0],
                                                              NO_BALLOT), bal)
                 entries.append(LogEntry(REC_ACCEPT, self.table.by_row(
@@ -554,13 +583,14 @@ class PaxosNode:
         cur = self._cursor.get(row, 0)
         dec = self._dec[row]
         while cur in dec:
-            req_id = dec.pop(cur)
-            flags, payload = self._payloads.pop(req_id, (None, b""))
-            if flags is None:
+            req_id = dec[cur]
+            got = self._payloads.get(req_id)
+            if got is None or (got[0] & FLAG_MISSING):
                 # we never saw the accept (gap): ask peers, stop here
-                dec[cur] = req_id
                 self._sync_if_gap(row)
                 break
+            dec.pop(cur)
+            flags, payload = self._payloads.pop(req_id)
             if not (flags & FLAG_NOOP):
                 resp = self.app.execute(meta.name, req_id, payload,
                                         bool(flags & FLAG_STOP))
@@ -616,23 +646,25 @@ class PaxosNode:
         if meta is None:
             return
         row = meta.row
+        # serve only decisions whose payload we actually hold — never
+        # fabricate an empty payload for one we don't (replica divergence)
         have = []
         for s in range(o.from_slot, o.to_slot):
-            if s in self._dec.get(row, {}):
-                have.append((s, self._dec[row][s]))
-        # serve decisions we executed from the WAL-less hot mirror is not
-        # possible below cursor; offer a checkpoint instead
-        if not have and self._cursor.get(row, 0) > o.from_slot:
-            rec = self.logger.get_checkpoint(meta.gkey)
-            state = self.app.checkpoint(meta.name)
-            self._route(o.sender, pkt.CheckpointReply(
-                self.id, meta.gkey, self._cursor.get(row, 0) - 1, state))
-            return
+            req = self._dec.get(row, {}).get(s)
+            if req is not None and req in self._payloads:
+                have.append((s, req))
         if not have:
+            # decisions already executed & GC'd: catch the laggard up with
+            # a whole-state checkpoint instead (ref: StatePacket path)
+            if self._cursor.get(row, 0) > o.from_slot:
+                state = self.app.checkpoint(meta.name)
+                self._route(o.sender, pkt.CheckpointReply(
+                    self.id, meta.gkey, self._cursor.get(row, 0) - 1,
+                    state))
             return
         pls = []
         for s, req in have:
-            fl, pl = self._payloads.get(req, (0, b""))
+            fl, pl = self._payloads[req]
             pls.append(bytes([fl]) + pl)
         self._route(o.sender, pkt.SyncReply(
             self.id, meta.gkey,
@@ -648,8 +680,9 @@ class PaxosNode:
         for j in range(len(o.slots)):
             req = _join_req(int(o.req_lo[j]), int(o.req_hi[j]))
             blob = pls[j]
-            if blob:
-                self._payloads.setdefault(req, (blob[0], bytes(blob[1:])))
+            if not blob or (blob[0] & FLAG_MISSING):
+                continue  # sender had no payload: don't install the slot
+            self._store_payload(req, blob[0], bytes(blob[1:]))
             ded[(meta.row, int(o.slots[j]))] = req
         if not ded:
             return
@@ -662,6 +695,31 @@ class PaxosNode:
             if res.applied[i] or res.stale[i]:
                 self._dec[k[0]][k[1]] = ded[k]
         self._execute_row(meta.row)
+
+    def _handle_checkpoint_reply(self, o) -> None:
+        """Whole-state catch-up: a peer's checkpoint replaces our (lagging)
+        app state and advances the frontier (ref: StatePacket install)."""
+        meta = self.table.by_key(o.gkey)
+        if meta is None:
+            return
+        row = meta.row
+        cur = self._cursor.get(row, 0)
+        if o.slot < cur:
+            return  # stale: we are already past it
+        self.app.restore(meta.name, o.state)
+        newcur = o.slot + 1
+        self._cursor[row] = newcur
+        d = self._dec.get(row, {})
+        for s in [s for s in d if s < newcur]:
+            self._payloads.pop(d.pop(s), None)
+        self.backend.set_cursor(np.asarray([row], np.int32),
+                                np.asarray([newcur], np.int32),
+                                np.asarray([newcur], np.int32))
+        self._ckpt_slot[row] = o.slot
+        self.logger.checkpoint(CheckpointRec(
+            meta.gkey, meta.name, meta.version, meta.members, o.slot,
+            o.state))
+        self._execute_row(row)
 
     # ------------------------------------------------------------------
     # failover (ref: §3.5 coordinator failover)
@@ -732,7 +790,10 @@ class PaxosNode:
             for j in range(m):
                 req = _join_req(int(res.win_req_lo[i][j]),
                                 int(res.win_req_hi[i][j]))
-                fl, pl = self._payloads.get(req, (0, b""))
+                got = self._payloads.get(req)
+                # never fabricate a payload we don't hold: report the
+                # pvalue (safety requires it) but flag it payload-less
+                fl, pl = got if got is not None else (FLAG_MISSING, b"")
                 pls.append(bytes([fl]) + pl)
             self._route(sender, pkt.PrepareReply(
                 self.id, meta.gkey, bal if res.acked[i]
@@ -765,8 +826,14 @@ class PaxosNode:
             b = int(o.bals[j])
             req = _join_req(int(o.req_lo[j]), int(o.req_hi[j]))
             blob = pls[j]
-            fl, pl = (blob[0], bytes(blob[1:])) if blob else (0, b"")
-            if s not in el.merged or b > el.merged[s][0]:
+            fl, pl = (blob[0], bytes(blob[1:])) if blob \
+                else (FLAG_MISSING, b"")
+            prev = el.merged.get(s)
+            # max-ballot wins (safety); at equal ballot the value is
+            # identical, so prefer a copy that carries the payload
+            if prev is None or b > prev[0] or (
+                    b == prev[0] and (prev[2] & FLAG_MISSING)
+                    and not (fl & FLAG_MISSING)):
                 el.merged[s] = (b, req, fl, pl)
         if len(el.acks) < len(meta.members) // 2 + 1:
             return
@@ -777,6 +844,12 @@ class PaxosNode:
     def _install_as_coordinator(self, row: int, meta, el: _Election) -> None:
         cursor = max(el.cursor, self._cursor.get(row, 0))
         carry = {s: v for s, v in el.merged.items() if s >= cursor}
+        # fill payload-less carryovers from our own store when possible
+        for s, (b, req, fl, pl) in list(carry.items()):
+            if fl & FLAG_MISSING:
+                got = self._payloads.get(req)
+                if got is not None:
+                    carry[s] = (b, req, got[0], got[1])
         top = max(carry.keys(), default=cursor - 1)
         # holes become noops (classic multipaxos hole fill)
         for s in range(cursor, top + 1):
@@ -873,8 +946,8 @@ class PaxosNode:
                 acc_bals.append(e.bal)
                 acc_reqs.append(e.req_id)
                 if e.payload:
-                    self._payloads.setdefault(
-                        e.req_id, (e.payload[0], bytes(e.payload[1:])))
+                    self._store_payload(
+                        e.req_id, e.payload[0], bytes(e.payload[1:]))
                 self._bal_seen[meta.row] = max(
                     self._bal_seen.get(meta.row, NO_BALLOT), e.bal)
             else:
