@@ -108,6 +108,13 @@ class PaxosNode:
         self._client_wait: Dict[int, int] = {}
         # coordinator dedupe: req_id -> True while in flight
         self._proposed: Set[int] = set()
+        # recently executed req_ids with timestamps — practical at-most-once
+        # for client retransmits that cross a coordinator change (ref:
+        # GCConcurrentHashMap outstanding-request tables, time-GC'd)
+        self._executed_recent: Dict[int, float] = {}
+        # req_id -> response bytes for executed requests: a deduped
+        # retransmit is ANSWERED from here, never silently dropped
+        self._resp_cache: Dict[int, bytes] = {}
         self._elections: Dict[int, _Election] = {}
 
         # failure detection (ref: gigapaxos/FailureDetection.java)
@@ -296,6 +303,19 @@ class PaxosNode:
                 if now - t > self.failure_timeout]
         for n in dead:
             self._on_node_dead(n)
+        # GC the dedupe + response-cache + waiter tables (time TTL)
+        if len(self._executed_recent) > 100000 or \
+                getattr(self, "_last_exec_gc", 0) + 30 < now:
+            self._last_exec_gc = now
+            cutoff = now - 60
+            self._executed_recent = {
+                r: t for r, t in self._executed_recent.items()
+                if t > cutoff}
+            self._resp_cache = {r: v for r, v in self._resp_cache.items()
+                                if r in self._executed_recent}
+            self._client_wait = {
+                r: w for r, w in self._client_wait.items()
+                if w[1] > now - 120}
 
     # -- batch processing ----------------------------------------------
 
@@ -322,6 +342,13 @@ class PaxosNode:
         for o in by_type.pop(pkt.FailureDetect, []):
             if not o.is_pong:
                 self._route(o.sender, pkt.FailureDetect(self.id, 1, o.ts_ns))
+        for o in by_type.pop(pkt.Response, []):
+            # a peer answered a forwarded (deduped) proposal: relay to the
+            # client still waiting on us as its entry replica
+            waiter = self._client_wait.pop(o.req_id, None)
+            if waiter is not None:
+                self._route(waiter[0], pkt.Response(
+                    self.id, o.gkey, o.req_id, o.status, o.payload))
         for o in by_type.pop(pkt.SyncRequest, []):
             self._handle_sync_request(o)
         for o in by_type.pop(pkt.SyncReply, []):
@@ -371,16 +398,32 @@ class PaxosNode:
                 self._route(o.sender, pkt.Response(
                     self.id, o.gkey, o.req_id, 2, b""))
                 continue
-            self._client_wait[o.req_id] = o.sender
+            if o.req_id in self._executed_recent:
+                # retransmit of an executed request: answer from the
+                # response cache, never drop silently (at-most-once + reply)
+                self._route(o.sender, pkt.Response(
+                    self.id, o.gkey, o.req_id, 0,
+                    self._resp_cache.get(o.req_id, b"")))
+                continue
+            self._client_wait[o.req_id] = (o.sender, time.time())
             coord = unpack_ballot(self._bal_seen[meta.row])[1]
             if coord != self.id:
                 self._route(coord, pkt.Proposal(
                     self.id, o.gkey, o.req_id, o.sender, o.flags, o.payload))
                 continue
+            if o.req_id in self._proposed:
+                continue
             lanes.append((meta.row, o.req_id, o.flags, o.payload, o.sender))
         for o in props:
             meta = self.table.by_key(o.gkey)
             if meta is None:
+                continue
+            if o.req_id in self._executed_recent:
+                # answer rides a Response to the entry replica, which
+                # relays it to the waiting client (see Response handler)
+                self._route(o.sender, pkt.Response(
+                    self.id, o.gkey, o.req_id, 0,
+                    self._resp_cache.get(o.req_id, b"")))
                 continue
             coord = unpack_ballot(self._bal_seen[meta.row])[1]
             if coord != self.id:
@@ -389,11 +432,9 @@ class PaxosNode:
                 if coord >= 0 and coord != o.sender:
                     self._route(coord, o)
                 continue
+            if o.req_id in self._proposed:
+                continue
             lanes.append((meta.row, o.req_id, o.flags, o.payload, o.entry))
-        if not lanes:
-            return
-        # dedupe in-flight req_ids (client/proposal retransmits)
-        lanes = [l for l in lanes if l[1] not in self._proposed]
         if not lanes:
             return
         rows = np.asarray([l[0] for l in lanes], np.int32)
@@ -403,6 +444,15 @@ class PaxosNode:
             if res.granted[i]:
                 self._proposed.add(req_id)
                 self._store_payload(req_id, flags, payload)
+            elif res.rejected[i]:
+                # we believed we coordinate this group but the device
+                # disagrees (post-restart: coordinatorship is never assumed
+                # on recovery) — regain it via phase 1; the client's
+                # retransmit rides the new ballot
+                meta = self.table.by_row(row)
+                if meta is not None and unpack_ballot(
+                        self._bal_seen.get(row, NO_BALLOT))[1] == self.id:
+                    self._start_election(row, meta)
         self._emit_accepts(lanes, res)
 
     def _emit_accepts(self, lanes, res) -> None:
@@ -598,9 +648,11 @@ class PaxosNode:
                 resp = b""
             self.n_executed += 1
             self._proposed.discard(req_id)
-            client = self._client_wait.pop(req_id, None)
-            if client is not None:
-                self._route(client, pkt.Response(
+            self._executed_recent[req_id] = time.time()
+            self._resp_cache[req_id] = resp
+            waiter = self._client_wait.pop(req_id, None)
+            if waiter is not None:
+                self._route(waiter[0], pkt.Response(
                     self.id, meta.gkey, req_id, 0, resp))
             cur += 1
         self._cursor[row] = cur
