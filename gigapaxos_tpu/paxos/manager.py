@@ -108,6 +108,10 @@ class PaxosNode:
         self._client_wait: Dict[int, int] = {}
         # coordinator dedupe: req_id -> True while in flight
         self._proposed: Set[int] = set()
+        # rows whose epoch-stop request has executed: the RSM is closed —
+        # later decided slots are skipped and clients told to re-resolve
+        # (ref: PaxosInstanceStateMachine stopped/final-state logic)
+        self._group_stopped: Set[int] = set()
         # recently executed req_ids with timestamps — practical at-most-once
         # for client retransmits that cross a coordinator change (ref:
         # GCConcurrentHashMap outstanding-request tables, time-GC'd)
@@ -121,6 +125,12 @@ class PaxosNode:
         self._last_heard: Dict[int, float] = {}
         self.ping_interval = float(Config.get(PC.PING_INTERVAL_S))
         self.failure_timeout = float(Config.get(PC.FAILURE_TIMEOUT_S))
+
+        # upper-layer plugin points (ref: AbstractPacketDemultiplexer
+        # .register + PaxosManager's periodic tasks): handlers run on the
+        # worker thread, preserving the single-writer discipline
+        self._handlers: Dict[type, List] = {}
+        self._tick_hooks: List = []
 
         self._inq: "queue_mod.Queue" = queue_mod.Queue()
         self._stopping = False
@@ -193,6 +203,7 @@ class PaxosNode:
         if self.table.by_name(name) is not None:
             return False
         meta = self.table.create(name, members, version)
+        self._group_stopped.discard(meta.row)  # rows are recycled
         coord = members[meta.gkey % len(members)]
         init_bal = pack_ballot(0, coord)
         self.backend.create(
@@ -223,6 +234,7 @@ class PaxosNode:
         for d in (self._bal_seen, self._cursor, self._dec, self._ckpt_slot):
             d.pop(meta.row, None)
         self._elections.pop(meta.row, None)
+        self._group_stopped.discard(meta.row)
         self.logger.delete_group(meta.gkey)
         self.logger.delete_checkpoint(meta.gkey)
         self.app.restore(meta.name, b"")
@@ -299,6 +311,11 @@ class PaxosNode:
         if getattr(self, "_last_tick", 0) + self.ping_interval > now:
             return
         self._last_tick = now
+        for fn in self._tick_hooks:
+            try:
+                fn()
+            except Exception:
+                log.exception("tick hook %r failed", fn)
         dead = [n for n, t in self._last_heard.items()
                 if now - t > self.failure_timeout]
         for n in dead:
@@ -385,8 +402,27 @@ class PaxosNode:
         if commits:
             self._handle_commits(commits)
         for t, objs in by_type.items():
-            log.warning("unhandled packet type %s x%d", t.__name__,
-                        len(objs))
+            handlers = self._handlers.get(t)
+            if not handlers:
+                log.warning("unhandled packet type %s x%d", t.__name__,
+                            len(objs))
+                continue
+            for o in objs:
+                for h in handlers:
+                    try:
+                        h(o)
+                    except Exception:
+                        log.exception("handler %r failed", h)
+
+    def register_handler(self, ptype: type, fn) -> None:
+        """Register an upper-layer handler for a packet class (called on
+        the worker thread; ref: ``AbstractPacketDemultiplexer.register``)."""
+        self._handlers.setdefault(ptype, []).append(fn)
+
+    def add_tick_hook(self, fn) -> None:
+        """Run ``fn()`` on the worker thread every ping interval (upper
+        layers: epoch-FSM retries, demand reporting)."""
+        self._tick_hooks.append(fn)
 
     # -- request/proposal → propose ------------------------------------
 
@@ -404,6 +440,10 @@ class PaxosNode:
                 self._route(o.sender, pkt.Response(
                     self.id, o.gkey, o.req_id, 0,
                     self._resp_cache.get(o.req_id, b"")))
+                continue
+            if meta.row in self._group_stopped:
+                self._route(o.sender, pkt.Response(
+                    self.id, o.gkey, o.req_id, 3, b""))
                 continue
             self._client_wait[o.req_id] = (o.sender, time.time())
             coord = unpack_ballot(self._bal_seen[meta.row])[1]
@@ -424,6 +464,10 @@ class PaxosNode:
                 self._route(o.sender, pkt.Response(
                     self.id, o.gkey, o.req_id, 0,
                     self._resp_cache.get(o.req_id, b"")))
+                continue
+            if meta.row in self._group_stopped:
+                self._route(o.sender, pkt.Response(
+                    self.id, o.gkey, o.req_id, 3, b""))
                 continue
             coord = unpack_ballot(self._bal_seen[meta.row])[1]
             if coord != self.id:
@@ -467,12 +511,14 @@ class PaxosNode:
             for m in meta.members:
                 by_dst.setdefault(m, []).append(i)
         for dst, idxs in by_dst.items():
-            sel = lambda f: np.asarray([f(i) for i in idxs])
+            # NB: gkeys straddle 2^63, so the dtype must be pinned — a bare
+            # np.asarray promotes mixed int magnitudes to float64 and
+            # silently corrupts keys past the 53-bit mantissa
             ab = pkt.AcceptBatch(
                 self.id,
-                sel(lambda i: metas[i].gkey).astype(np.uint64),
-                sel(lambda i: int(res.slot[i])).astype(np.int32),
-                sel(lambda i: int(res.cbal[i])).astype(np.int32),
+                np.asarray([metas[i].gkey for i in idxs], np.uint64),
+                np.asarray([int(res.slot[i]) for i in idxs], np.int32),
+                np.asarray([int(res.cbal[i]) for i in idxs], np.int32),
                 *_split_reqs([lanes[i][1] for i in idxs]),
                 payloads=[bytes([lanes[i][2]]) + lanes[i][3] for i in idxs])
             self._route(dst, ab)
@@ -641,11 +687,19 @@ class PaxosNode:
                 break
             dec.pop(cur)
             flags, payload = self._payloads.pop(req_id)
-            if not (flags & FLAG_NOOP):
+            status = 0
+            if flags & FLAG_NOOP:
+                resp = b""
+            elif row in self._group_stopped:
+                # decided after the epoch's stop slot: NOT applied (the
+                # final state excludes it); tell the client to re-resolve
+                # the group and retry (ref: stopped-instance handling)
+                resp, status = b"", 3
+            else:
                 resp = self.app.execute(meta.name, req_id, payload,
                                         bool(flags & FLAG_STOP))
-            else:
-                resp = b""
+                if flags & FLAG_STOP:
+                    self._group_stopped.add(row)
             self.n_executed += 1
             self._proposed.discard(req_id)
             self._executed_recent[req_id] = time.time()
@@ -653,7 +707,7 @@ class PaxosNode:
             waiter = self._client_wait.pop(req_id, None)
             if waiter is not None:
                 self._route(waiter[0], pkt.Response(
-                    self.id, meta.gkey, req_id, 0, resp))
+                    self.id, meta.gkey, req_id, status, resp))
             cur += 1
         self._cursor[row] = cur
         # (device cursor advances in the commit kernel; no set_cursor here)

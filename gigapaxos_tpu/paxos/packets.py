@@ -64,6 +64,7 @@ class PacketType(IntEnum):
     SYNC_REPLY = 14
     CHECKPOINT_REQUEST = 15  # ask a peer for its latest app checkpoint
     CHECKPOINT_REPLY = 16
+    CONTROL = 17          # JSON control-plane envelope (reconfiguration)
 
 
 _HDR = struct.Struct("<BII")  # type, sender (u32, matches the transport's
@@ -274,7 +275,7 @@ class Proposal:
     payload: bytes
 
     TYPE = PacketType.PROPOSAL
-    _S = struct.Struct("<QQHB")
+    _S = struct.Struct("<QQIB")
 
     def encode(self) -> bytes:
         return (_HDR.pack(self.TYPE, self.sender, 1) +
@@ -536,6 +537,32 @@ class CheckpointReply:
         return cls(sender, gkey, slot, bytes(body[cls._S.size:]))
 
 
+@dataclass
+class Control:
+    """JSON control-plane envelope (cold path; reconfiguration layer).
+
+    Ref: ``reconfiguration/reconfigurationpackets/*`` — the reference keeps
+    its whole control plane on JSON; only the paxos hot path is byteified.
+    ``body["rc"]`` names the reconfiguration packet type (``create``,
+    ``start_epoch``, ...); the rest of ``body`` is that packet's fields.
+    """
+
+    sender: int
+    body: dict
+
+    TYPE = PacketType.CONTROL
+
+    def encode(self) -> bytes:
+        import json as _json
+        return (_HDR.pack(self.TYPE, self.sender, 1) +
+                _json.dumps(self.body, separators=(",", ":")).encode())
+
+    @classmethod
+    def decode(cls, sender, n, body) -> "Control":
+        import json as _json
+        return cls(sender, _json.loads(bytes(body).decode()))
+
+
 # --------------------------------------------------------------------------
 # dispatch
 # --------------------------------------------------------------------------
@@ -557,6 +584,7 @@ _DECODERS = {
     PacketType.SYNC_REPLY: SyncReply,
     PacketType.CHECKPOINT_REQUEST: CheckpointRequest,
     PacketType.CHECKPOINT_REPLY: CheckpointReply,
+    PacketType.CONTROL: Control,
 }
 
 

@@ -1,0 +1,23 @@
+"""Reconfiguration control plane (L4).
+
+Reference analog: ``src/edu/umass/cs/reconfiguration/`` — the substrate
+that creates/deletes/moves replica groups online.  The control plane
+*itself* runs on the same paxos engine (its own "RC groups" among the
+reconfigurator nodes), exactly like the reference's layered re-entrancy
+(SURVEY.md §3.4).
+"""
+
+from gigapaxos_tpu.reconfiguration.activereplica import ActiveReplica
+from gigapaxos_tpu.reconfiguration.appclient import ReconfigurableAppClient
+from gigapaxos_tpu.reconfiguration.consistenthash import ConsistentHashing
+from gigapaxos_tpu.reconfiguration.coordinator import (
+    AbstractReplicaCoordinator, PaxosReplicaCoordinator)
+from gigapaxos_tpu.reconfiguration.node import ReconfigurableNode
+from gigapaxos_tpu.reconfiguration.rcdb import RCRecord, ReconfiguratorDB
+from gigapaxos_tpu.reconfiguration.reconfigurator import Reconfigurator
+
+__all__ = [
+    "ActiveReplica", "ReconfigurableAppClient", "ConsistentHashing",
+    "AbstractReplicaCoordinator", "PaxosReplicaCoordinator",
+    "ReconfigurableNode", "RCRecord", "ReconfiguratorDB", "Reconfigurator",
+]

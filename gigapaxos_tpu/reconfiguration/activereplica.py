@@ -1,0 +1,143 @@
+"""Active replica: the data-plane front of the reconfiguration substrate.
+
+Reference analog: ``reconfiguration/ActiveReplica.java`` — demultiplexes
+app traffic vs reconfiguration packets; handles ``StartEpoch`` /
+``StopEpoch`` / ``DropEpochFinalState``; emits ``DemandReport``s.  Here it
+owns a :class:`PaxosNode` whose app is a :class:`PaxosReplicaCoordinator`
+wrapping the user app, and registers a ``Control`` handler on the node's
+worker thread (single-writer discipline preserved).
+
+Epoch-stop design: ``stop_epoch`` injects a *stop request* (FLAG_STOP) into
+the group through normal paxos with a deterministic request id, so every
+replica stops at the same slot; the coordinator wrapper captures
+``checkpoint(name)`` at that slot as the epoch final state (ref:
+``AbstractReplicaCoordinator`` stoppable wrappers + ``EpochFinalState``).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Optional, Tuple
+
+from gigapaxos_tpu.paxos import packets as pkt
+from gigapaxos_tpu.paxos.interfaces import Replicable
+from gigapaxos_tpu.paxos.manager import FLAG_STOP, PaxosNode
+from gigapaxos_tpu.reconfiguration import rcpackets as rc
+from gigapaxos_tpu.reconfiguration.coordinator import PaxosReplicaCoordinator
+from gigapaxos_tpu.reconfiguration.rcdb import b64d, b64e
+from gigapaxos_tpu.utils.logutil import get_logger
+
+log = get_logger("gp.active")
+
+
+def stop_req_id(name: str, epoch: int) -> int:
+    """Deterministic id for a group-epoch's stop request: every active (and
+    every reconfigurator retry) proposes the SAME id, so the engine's
+    request dedup collapses them into one decided stop."""
+    return pkt.group_key(f"{name}:{epoch}:__stop__") | (1 << 63)
+
+
+class ActiveReplica:
+    """One active node: engine + epoch lifecycle + demand reporting."""
+
+    def __init__(self, node_id: int, addr_map: Dict[int, Tuple[str, int]],
+                 reconfigurators: Tuple[int, ...], app: Replicable,
+                 logdir: str, demand_report_every: int = 100, **node_kw):
+        self.id = node_id
+        self.reconfigurators = tuple(reconfigurators)
+        self.coordinator = PaxosReplicaCoordinator(app)
+        self.node = PaxosNode(node_id, addr_map, self.coordinator, logdir,
+                              **node_kw)
+        self.coordinator.bind(self.node)
+        self.demand_report_every = demand_report_every
+        self._demand_acc: Dict[str, int] = {}
+        # stops we have been asked for but whose group is still running
+        self._pending_stops: Dict[str, Tuple[int, int]] = {}  # name->(ep,rc)
+        self.node.register_handler(pkt.Control, self._on_control)
+        self.node.add_tick_hook(self._tick)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        self.node.start()
+
+    def stop(self) -> None:
+        self.node.stop()
+
+    @property
+    def port(self) -> int:
+        return self.node.port
+
+    # -- control handling (worker thread) ----------------------------------
+
+    def _on_control(self, o: pkt.Control) -> None:
+        b = o.body
+        t = b.get("rc")
+        if t == rc.START_EPOCH:
+            self._handle_start_epoch(o.sender, b)
+        elif t == rc.STOP_EPOCH:
+            self._handle_stop_epoch(o.sender, b)
+        elif t == rc.DROP_EPOCH:
+            self._handle_drop_epoch(o.sender, b)
+        elif t == rc.ECHO:
+            self.node._route(o.sender, pkt.Control(self.id, b))
+        else:
+            log.warning("active %d: unexpected control %r", self.id, t)
+
+    def _handle_start_epoch(self, sender: int, b: dict) -> None:
+        name, epoch = b["name"], b["epoch"]
+        ok = self.coordinator.create_replica_group(
+            name, epoch, tuple(b["actives"]), b64d(b.get("init", "")))
+        if ok:
+            self._pending_stops.pop(name, None)
+            self.node._route(sender, pkt.Control(
+                self.id, rc.ack_start(name, epoch)))
+
+    def _handle_stop_epoch(self, sender: int, b: dict) -> None:
+        name, epoch = b["name"], b["epoch"]
+        done = self.coordinator.stopped_state(name)
+        if done is not None and done[0] >= epoch:
+            self.node._route(sender, pkt.Control(
+                self.id, rc.ack_stop(name, done[0], b64e(done[1]))))
+            return
+        meta = self.node.table.by_name(name)
+        if meta is None or meta.version > epoch:
+            # group already dropped/advanced: ack without state (the
+            # reconfigurator only needs one state-bearing ack)
+            self.node._route(sender, pkt.Control(
+                self.id, rc.ack_stop(name, epoch, "")))
+            return
+        self._pending_stops[name] = (epoch, sender)
+        # propose the epoch-stop through paxos (dedup via deterministic id)
+        self.node._inq.put(pkt.Request(
+            self.id, meta.gkey, stop_req_id(name, epoch), FLAG_STOP, b""))
+
+    def _handle_drop_epoch(self, sender: int, b: dict) -> None:
+        name, epoch = b["name"], b["epoch"]
+        meta = self.node.table.by_name(name)
+        if meta is not None and meta.version <= epoch:
+            self.coordinator.delete_replica_group(name)
+        self._pending_stops.pop(name, None)
+        self.node._route(sender, pkt.Control(
+            self.id, rc.ack_drop(name, epoch)))
+
+    # -- periodic (worker thread) ------------------------------------------
+
+    def _tick(self) -> None:
+        # answer pending stops whose stop request has now executed
+        for name, (epoch, sender) in list(self._pending_stops.items()):
+            done = self.coordinator.stopped_state(name)
+            if done is not None and done[0] >= epoch:
+                del self._pending_stops[name]
+                self.node._route(sender, pkt.Control(
+                    self.id, rc.ack_stop(name, done[0], b64e(done[1]))))
+        # demand reporting (ref: DemandReport via AggregateDemandProfiler)
+        for name, cnt in self.coordinator.drain_demand().items():
+            self._demand_acc[name] = self._demand_acc.get(name, 0) + cnt
+        ready = {n: c for n, c in self._demand_acc.items()
+                 if c >= self.demand_report_every}
+        if ready and self.reconfigurators:
+            for n in ready:
+                del self._demand_acc[n]
+            dst = self.reconfigurators[self.id % len(self.reconfigurators)]
+            self.node._route(dst, pkt.Control(self.id, rc.demand(ready)))

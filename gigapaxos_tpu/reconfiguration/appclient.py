@@ -1,0 +1,189 @@
+"""Full reconfiguration-aware async client.
+
+Reference analog: ``reconfiguration/ReconfigurableAppClientAsync.java`` —
+name create/delete/lookup against reconfigurators plus app requests against
+actives, with an active-replica cache refreshed on misses and retries with
+failover (ref also: ``E2ELatencyAwareRedirector`` — here: stick with the
+last replica that answered).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import struct
+from typing import Dict, List, Optional, Tuple
+
+from gigapaxos_tpu.paxos import packets as pkt
+from gigapaxos_tpu.reconfiguration import rcpackets as rc
+from gigapaxos_tpu.reconfiguration.node import NodeConfig
+from gigapaxos_tpu.reconfiguration.rcdb import b64e
+from gigapaxos_tpu.utils.logutil import get_logger
+
+log = get_logger("gp.appclient")
+
+_LEN = struct.Struct("<I")
+
+CLIENT_ID_BASE = 1 << 16  # below this: server node ids (id spaces disjoint)
+
+
+class ReconfigurableAppClient:
+    """``await`` API: create/delete/actives/move + send_request."""
+
+    def __init__(self, client_id: int, config: NodeConfig,
+                 timeout: float = 5.0, retries: int = 3):
+        assert CLIENT_ID_BASE <= client_id < (1 << 31)
+        self.id = client_id
+        self.config = config
+        self.timeout = timeout
+        self.retries = retries
+        self._seq = itertools.count(1)
+        self._conns: Dict[int, Tuple[asyncio.StreamReader,
+                                     asyncio.StreamWriter]] = {}
+        self._read_tasks: Dict[int, asyncio.Task] = {}
+        self._waiting: Dict[int, asyncio.Future] = {}
+        self._actives_cache: Dict[str, List[int]] = {}
+        self._preferred: Dict[str, int] = {}   # name -> active that answered
+        self._rcs = sorted(config.reconfigurators)
+
+    # -- plumbing ----------------------------------------------------------
+
+    def _rid(self) -> int:
+        return (self.id << 32) | next(self._seq)
+
+    async def _conn(self, node: int):
+        c = self._conns.get(node)
+        if c is not None and not c[1].is_closing():
+            return c
+        host, port = self.config.addr_map[node]
+        reader, writer = await asyncio.open_connection(host, port)
+        writer.write(_LEN.pack(4) + struct.pack("<i", self.id))
+        self._conns[node] = (reader, writer)
+        self._read_tasks[node] = asyncio.get_running_loop().create_task(
+            self._read_loop(node, reader))
+        return reader, writer
+
+    async def _read_loop(self, node: int, reader: asyncio.StreamReader):
+        try:
+            while True:
+                hdr = await reader.readexactly(4)
+                (ln,) = _LEN.unpack(hdr)
+                frame = await reader.readexactly(ln)
+                obj = pkt.decode(frame)
+                rid = None
+                if isinstance(obj, pkt.Response):
+                    rid = obj.req_id
+                elif isinstance(obj, pkt.Control) and \
+                        obj.body.get("rc") == rc.REPLY:
+                    rid = obj.body.get("rid")
+                if rid is not None:
+                    fut = self._waiting.pop(rid, None)
+                    if fut is not None and not fut.done():
+                        fut.set_result(obj)
+        except (asyncio.IncompleteReadError, ConnectionError, OSError,
+                asyncio.CancelledError):
+            self._conns.pop(node, None)
+
+    async def _rpc(self, node: int, rid: int, frame: bytes):
+        _, writer = await self._conn(node)
+        fut = asyncio.get_running_loop().create_future()
+        self._waiting[rid] = fut
+        try:
+            writer.write(_LEN.pack(len(frame)) + frame)
+            await writer.drain()
+            return await asyncio.wait_for(fut, self.timeout)
+        finally:
+            self._waiting.pop(rid, None)
+
+    async def _control(self, body: dict) -> dict:
+        """Send a control op to a reconfigurator, retrying across them."""
+        last: Optional[Exception] = None
+        for attempt in range(self.retries + 1):
+            node = self._rcs[attempt % len(self._rcs)]
+            try:
+                resp = await self._rpc(node, body["rid"],
+                                       pkt.Control(self.id, body).encode())
+                return resp.body
+            except (asyncio.TimeoutError, ConnectionError, OSError) as e:
+                last = e
+        raise TimeoutError(f"control op {body.get('rc')} failed: {last}")
+
+    # -- name ops ----------------------------------------------------------
+
+    async def create(self, name: str, initial_state: bytes = b"") -> bool:
+        b = await self._control(rc.create_name(name, b64e(initial_state),
+                                               self._rid()))
+        if b.get("ok"):
+            self._actives_cache[name] = list(b.get("actives") or [])
+        return bool(b.get("ok"))
+
+    async def delete(self, name: str) -> bool:
+        b = await self._control(rc.delete_name(name, self._rid()))
+        self._actives_cache.pop(name, None)
+        self._preferred.pop(name, None)
+        return bool(b.get("ok"))
+
+    async def get_actives(self, name: str) -> List[int]:
+        b = await self._control(rc.req_actives(name, self._rid()))
+        if not b.get("ok"):
+            raise KeyError(f"no such service: {name}")
+        self._actives_cache[name] = list(b["actives"])
+        return self._actives_cache[name]
+
+    async def move(self, name: str, new_actives: List[int]) -> bool:
+        b = await self._control(rc.move_name(name, list(new_actives),
+                                             self._rid()))
+        if b.get("ok"):
+            self._actives_cache[name] = list(b.get("actives") or
+                                             new_actives)
+            self._preferred.pop(name, None)
+        return bool(b.get("ok"))
+
+    # -- app requests ------------------------------------------------------
+
+    async def send_request(self, name: str, payload: bytes,
+                           flags: int = 0) -> bytes:
+        gkey = pkt.group_key(name)
+        req_id = self._rid()
+        last: Optional[Exception] = None
+        for attempt in range(self.retries + 1):
+            actives = self._actives_cache.get(name)
+            if not actives:
+                actives = await self.get_actives(name)
+            pref = self._preferred.get(name)
+            dst = pref if (pref in actives and attempt == 0) else \
+                actives[attempt % len(actives)]
+            try:
+                resp = await self._rpc(
+                    dst, req_id,
+                    pkt.Request(self.id, gkey, req_id, flags,
+                                payload).encode())
+                if resp.status == 0:
+                    self._preferred[name] = dst
+                    return resp.payload
+                if resp.status in (2, 3):
+                    # 2: replica no longer hosts the group; 3: the group's
+                    # epoch stopped under us (reconfiguration in flight) —
+                    # refresh the actives cache and retry (ref: active-
+                    # replica cache invalidation on miss).  NB: a retried
+                    # non-idempotent request that was already decided
+                    # before the epoch's stop slot may re-execute in the
+                    # next epoch (dedup tables are per-node, matching the
+                    # reference); idempotent app ops are recommended across
+                    # reconfigurations.
+                    self._actives_cache.pop(name, None)
+                    self._preferred.pop(name, None)
+                    await asyncio.sleep(0.1)
+                last = RuntimeError(f"status={resp.status} from {dst}")
+            except (asyncio.TimeoutError, ConnectionError, OSError) as e:
+                self._preferred.pop(name, None)
+                last = e
+        raise TimeoutError(f"request to {name!r} failed: {last}")
+
+    async def close(self) -> None:
+        for t in self._read_tasks.values():
+            t.cancel()
+        for _, w in self._conns.values():
+            w.close()
+        self._conns.clear()
+        self._read_tasks.clear()

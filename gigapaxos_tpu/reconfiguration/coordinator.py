@@ -1,0 +1,118 @@
+"""Replica-coordinator SPI at active replicas.
+
+Reference analog: ``reconfiguration/AbstractReplicaCoordinator.java`` +
+``PaxosReplicaCoordinator.java`` — the layer that wraps the user app as a
+``Replicable``, maps replica-group create/delete onto the paxos engine, and
+intercepts epoch-stop requests so the active replica can capture the
+group's final state.
+"""
+
+from __future__ import annotations
+
+import abc
+import threading
+from typing import Dict, Optional, Tuple
+
+from gigapaxos_tpu.paxos.interfaces import Replicable
+
+
+class AbstractReplicaCoordinator(abc.ABC):
+    """SPI: ``coordinateRequest`` is implicit (requests ride the engine);
+    group lifecycle + stop interception are the explicit surface."""
+
+    @abc.abstractmethod
+    def create_replica_group(self, name: str, epoch: int,
+                             members: Tuple[int, ...],
+                             initial_state: bytes) -> bool: ...
+
+    @abc.abstractmethod
+    def delete_replica_group(self, name: str) -> bool: ...
+
+    @abc.abstractmethod
+    def get_replica_group(self, name: str) -> Optional[Tuple[int, ...]]: ...
+
+
+class PaxosReplicaCoordinator(AbstractReplicaCoordinator, Replicable):
+    """The bundled coordinator: wraps the user app, delegates lifecycle to
+    the local :class:`PaxosNode` (set via :meth:`bind`), and captures final
+    state when a stop request executes (ref: ``PaxosReplicaCoordinator``'s
+    use of ``PaxosManager`` + stoppable app wrappers)."""
+
+    def __init__(self, app: Replicable):
+        self.app = app
+        self.node = None  # set by bind()
+        # name -> (epoch, final_state) captured at stop execution
+        self._stopped: Dict[str, Tuple[int, bytes]] = {}
+        # names whose current epoch is stopped: reject new requests
+        self._lock = threading.Lock()
+        self.demand: Dict[str, int] = {}  # name -> request count (demand)
+
+    def bind(self, node) -> None:
+        self.node = node
+
+    # -- Replicable (the engine calls us; we call the user app) -----------
+
+    def execute(self, name: str, req_id: int, payload: bytes,
+                is_stop: bool = False) -> bytes:
+        with self._lock:
+            if name in self._stopped:
+                return b""  # epoch over: no further mutations
+            self.demand[name] = self.demand.get(name, 0) + 1
+        if is_stop:
+            # the stop request is the epoch's last decided slot: everything
+            # before it has executed, so checkpoint() IS the final state
+            final = self.app.checkpoint(name)
+            meta = self.node.table.by_name(name) if self.node else None
+            epoch = meta.version if meta else 0
+            with self._lock:
+                self._stopped[name] = (epoch, final)
+            return b""
+        return self.app.execute(name, req_id, payload, False)
+
+    def checkpoint(self, name: str) -> bytes:
+        return self.app.checkpoint(name)
+
+    def restore(self, name: str, state: bytes) -> bool:
+        return self.app.restore(name, state)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def create_replica_group(self, name: str, epoch: int,
+                             members: Tuple[int, ...],
+                             initial_state: bytes) -> bool:
+        existing = self.node.table.by_name(name)
+        if existing is not None:
+            if existing.version >= epoch:
+                return True  # idempotent re-create of the same/newer epoch
+            # stale prior epoch still present locally: clear it first
+            self.node.delete_group(name)
+        with self._lock:
+            # clear stop state only when actually starting a NEWER epoch —
+            # a retried start_epoch(e) arriving after epoch e stopped must
+            # not erase the captured final state and re-open the epoch
+            st = self._stopped.get(name)
+            if st is not None and st[0] < epoch:
+                del self._stopped[name]
+        return self.node.create_group(name, tuple(members), version=epoch,
+                                      initial_state=initial_state)
+
+    def delete_replica_group(self, name: str) -> bool:
+        with self._lock:
+            self._stopped.pop(name, None)
+        return self.node.delete_group(name)
+
+    def get_replica_group(self, name: str) -> Optional[Tuple[int, ...]]:
+        meta = self.node.table.by_name(name)
+        return meta.members if meta else None
+
+    # -- stop state --------------------------------------------------------
+
+    def stopped_state(self, name: str) -> Optional[Tuple[int, bytes]]:
+        with self._lock:
+            return self._stopped.get(name)
+
+    def drain_demand(self) -> Dict[str, int]:
+        with self._lock:
+            d = self.demand
+            self.demand = {}
+            return d

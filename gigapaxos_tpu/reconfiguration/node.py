@@ -1,0 +1,101 @@
+"""Process entry point: boot a node's roles from a node config.
+
+Reference analog: ``reconfiguration/ReconfigurableNode.java`` — reads the
+node map (``active.NAME=host:port`` / ``reconfigurator.NAME=host:port``)
+and boots an :class:`ActiveReplica` and/or :class:`Reconfigurator` for this
+node's roles (SURVEY.md §3.2).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Optional, Tuple
+
+from gigapaxos_tpu.paxos.interfaces import Replicable
+from gigapaxos_tpu.reconfiguration.activereplica import ActiveReplica
+from gigapaxos_tpu.reconfiguration.reconfigurator import Reconfigurator
+
+
+@dataclass
+class NodeConfig:
+    """The cluster map (ref: ``ReconfigurableNodeConfig`` +
+    ``gigapaxos.properties`` node entries).  Active and reconfigurator ids
+    must be disjoint."""
+
+    actives: Dict[int, Tuple[str, int]]
+    reconfigurators: Dict[int, Tuple[str, int]]
+    actives_per_name: int = 3
+    rc_group_size: int = 3
+
+    def __post_init__(self):
+        overlap = set(self.actives) & set(self.reconfigurators)
+        if overlap:
+            raise ValueError(f"ids in both roles: {overlap}")
+
+    @property
+    def addr_map(self) -> Dict[int, Tuple[str, int]]:
+        m = dict(self.actives)
+        m.update(self.reconfigurators)
+        return m
+
+    @classmethod
+    def from_properties(cls, path: str, **kw) -> "NodeConfig":
+        """Parse ``active.<id>=host:port`` / ``reconfigurator.<id>=host:port``
+        lines (ref: ``PaxosConfig`` ACTIVE.*/RECONFIGURATOR.* parsing)."""
+        actives: Dict[int, Tuple[str, int]] = {}
+        rcs: Dict[int, Tuple[str, int]] = {}
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line or line.startswith("#") or "=" not in line:
+                    continue
+                k, v = line.split("=", 1)
+                k, v = k.strip(), v.strip()
+                if ":" not in v:
+                    continue
+                host, port = v.rsplit(":", 1)
+                if k.startswith("active."):
+                    actives[int(k.split(".", 1)[1])] = (host, int(port))
+                elif k.startswith("reconfigurator."):
+                    rcs[int(k.split(".", 1)[1])] = (host, int(port))
+        return cls(actives, rcs, **kw)
+
+
+class ReconfigurableNode:
+    """Boots this node's roles and owns their lifecycles."""
+
+    def __init__(self, node_id: int, config: NodeConfig,
+                 app_factory: Callable[[], Replicable], logdir: str,
+                 **node_kw):
+        self.id = node_id
+        self.config = config
+        self.active: Optional[ActiveReplica] = None
+        self.reconfigurator: Optional[Reconfigurator] = None
+        amap = config.addr_map
+        if node_id in config.actives:
+            self.active = ActiveReplica(
+                node_id, amap, tuple(config.reconfigurators),
+                app_factory(), os.path.join(logdir, f"ar{node_id}"),
+                **node_kw)
+        if node_id in config.reconfigurators:
+            self.reconfigurator = Reconfigurator(
+                node_id, amap, tuple(config.reconfigurators),
+                tuple(config.actives),
+                os.path.join(logdir, f"rc{node_id}"),
+                actives_per_name=config.actives_per_name,
+                rc_group_size=config.rc_group_size, **node_kw)
+        if self.active is None and self.reconfigurator is None:
+            raise ValueError(f"node {node_id} has no role in the config")
+
+    def start(self) -> None:
+        if self.active:
+            self.active.start()
+        if self.reconfigurator:
+            self.reconfigurator.start()
+
+    def stop(self) -> None:
+        if self.active:
+            self.active.stop()
+        if self.reconfigurator:
+            self.reconfigurator.stop()

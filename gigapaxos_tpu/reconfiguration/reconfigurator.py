@@ -1,0 +1,337 @@
+"""Reconfigurator: the control-plane brain.
+
+Reference analog: ``reconfiguration/Reconfigurator.java`` — handles
+``CreateServiceName`` / ``DeleteServiceName`` / ``RequestActiveReplicas`` /
+``DemandReport``; drives the epoch FSM by committing record ops into *its
+own RC paxos groups* (ref ``RCRecordRequest``), then emitting
+``StartEpoch``/``StopEpoch``/``DropEpochFinalState`` to actives.
+
+Design mapping (SURVEY.md §3.4): every RC node executes every committed
+record op of the groups it belongs to (the engine replicates the
+:class:`ReconfiguratorDB`), so epoch side effects are emitted *by all group
+members idempotently* — acks dedupe at the actives, and FSM transitions
+dedupe in the DB (stale ops are no-ops).  This removes the reference's
+"responsible reconfigurator + backup timeout" complexity with no loss of
+fault tolerance: any surviving member completes any in-flight epoch change.
+
+RC group layout: one group per reconfigurator, ``_RC_<id>``, with
+``k`` consecutive members in sorted-id order; a name's record lives in the
+group of its consistent-hash owner (ref: ``ConsistentHashing`` of names
+onto reconfigurator groups).
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import time
+from typing import Callable, Dict, List, Optional, Set, Tuple
+
+from gigapaxos_tpu.paxos import packets as pkt
+from gigapaxos_tpu.paxos.manager import PaxosNode
+from gigapaxos_tpu.reconfiguration import rcpackets as rc
+from gigapaxos_tpu.reconfiguration.consistenthash import ConsistentHashing
+from gigapaxos_tpu.reconfiguration.rcdb import (READY, WAIT_ACK_START,
+                                                WAIT_ACK_STOP, RCRecord,
+                                                ReconfiguratorDB)
+from gigapaxos_tpu.utils.logutil import get_logger
+
+log = get_logger("gp.rc")
+
+# demand-profile SPI (ref: reconfigurationutils/AbstractDemandProfile):
+# (name, total_request_count, current_actives, all_actives) -> new actives
+# or None to leave placement alone
+DemandPolicy = Callable[[str, int, List[int], List[int]], Optional[List[int]]]
+
+
+class Reconfigurator:
+    """One reconfigurator node."""
+
+    def __init__(self, node_id: int, addr_map: Dict[int, Tuple[str, int]],
+                 reconfigurators: Tuple[int, ...],
+                 actives: Tuple[int, ...], logdir: str,
+                 actives_per_name: int = 3, rc_group_size: int = 3,
+                 demand_policy: Optional[DemandPolicy] = None, **node_kw):
+        self.id = node_id
+        self.rcs = tuple(sorted(reconfigurators))
+        self.actives = tuple(sorted(actives))
+        self.k_active = min(actives_per_name, len(self.actives))
+        self.k_rc = min(rc_group_size, len(self.rcs))
+        self.ch_rc = ConsistentHashing(self.rcs)
+        self.ch_active = ConsistentHashing(self.actives)
+        self.db = ReconfiguratorDB()
+        self.db.on_commit = self._on_commit
+        self.node = PaxosNode(node_id, addr_map, self.db, logdir, **node_kw)
+        self.node.register_handler(pkt.Control, self._on_control)
+        self.node.add_tick_hook(self._tick)
+        self._seq = itertools.count(1)
+        # name -> [(rid, client, kind)] awaiting a terminal transition
+        self._pending: Dict[str, List[Tuple[int, int, str]]] = {}
+        self._relay: Dict[int, int] = {}          # rid -> original client
+        self._acks_start: Dict[Tuple[str, int], Set[int]] = {}
+        self._final: Dict[Tuple[str, int], str] = {}   # epoch final states
+        self._demand: Dict[str, int] = {}
+        self.demand_policy = demand_policy
+        self._last_retry = 0.0
+        self.retry_s = 1.0
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        self.node.start()
+        # deterministic boot creates (idempotent vs recovery; every member
+        # creates its copy, like actives do on StartEpoch)
+        for g in self.my_groups():
+            self.node.create_group(g, self.group_members(g), version=0)
+
+    def stop(self) -> None:
+        self.node.stop()
+
+    @property
+    def port(self) -> int:
+        return self.node.port
+
+    # -- RC group layout ---------------------------------------------------
+
+    def group_of(self, name: str) -> str:
+        return f"_RC_{self.ch_rc.server(name)}"
+
+    def group_members(self, rc_group: str) -> Tuple[int, ...]:
+        owner = int(rc_group.rsplit("_", 1)[1])
+        i = self.rcs.index(owner)
+        return tuple(self.rcs[(i + j) % len(self.rcs)]
+                     for j in range(self.k_rc))
+
+    def my_groups(self) -> List[str]:
+        return [f"_RC_{x}" for x in self.rcs
+                if self.id in self.group_members(f"_RC_{x}")]
+
+    def _live_member(self, rc_group: str) -> int:
+        """First group member not currently suspected dead (fall back to
+        the first member if all are suspect)."""
+        now = time.time()
+        members = self.group_members(rc_group)
+        for m in members:
+            heard = self.node._last_heard.get(m)
+            if heard is None or now - heard <= self.node.failure_timeout:
+                return m
+        return members[0]
+
+    # -- proposing record ops into our own engine --------------------------
+
+    def _propose(self, rc_group: str, cmd: dict) -> None:
+        req_id = (self.id << 32) | next(self._seq)
+        self.node._inq.put(pkt.Request(
+            self.id, pkt.group_key(rc_group), req_id, 0,
+            json.dumps(cmd, separators=(",", ":")).encode()))
+
+    # -- client/active control traffic (worker thread) ---------------------
+
+    def _on_control(self, o: pkt.Control) -> None:
+        b = o.body
+        t = b.get("rc")
+        if t in (rc.CREATE_NAME, rc.DELETE_NAME, rc.REQ_ACTIVES,
+                 rc.MOVE_NAME):
+            self._client_op(o.sender, t, b)
+        elif t == rc.REPLY and b.get("rid") in self._relay:
+            self.node._route(self._relay.pop(b["rid"])[0],
+                             pkt.Control(self.id, b))
+        elif t == rc.ACK_START:
+            self._on_ack_start(o.sender, b)
+        elif t == rc.ACK_STOP:
+            self._on_ack_stop(o.sender, b)
+        elif t == rc.ACK_DROP:
+            pass
+        elif t == rc.DEMAND:
+            self._on_demand(o.sender, b)
+        elif t == rc.ECHO:
+            self.node._route(o.sender, pkt.Control(self.id, b))
+        else:
+            log.warning("rc %d: unexpected control %r", self.id, t)
+
+    def _client_op(self, sender: int, t: str, b: dict) -> None:
+        name, rid = b["name"], b["rid"]
+        grp = self.group_of(name)
+        if self.id not in self.group_members(grp):
+            # not our record: relay to a live member of the owning group
+            # (ref: reconfigurator forwarding), remember who to answer
+            self._relay[rid] = (sender, time.time())
+            self.node._route(self._live_member(grp),
+                             pkt.Control(self.id, b))
+            return
+        rec = self.db.lookup(grp, name)
+        if t == rc.REQ_ACTIVES:
+            if rec is None:
+                self.node._route(sender, pkt.Control(
+                    self.id, rc.reply(rid, False, err="nonexistent")))
+            else:
+                self.node._route(sender, pkt.Control(
+                    self.id, rc.reply(rid, True, rec.actives)))
+            return
+        if t == rc.CREATE_NAME:
+            if rec is not None and rec.state == READY:
+                self.node._route(sender, pkt.Control(
+                    self.id, rc.reply(rid, True, rec.actives)))
+                return
+            self._pending.setdefault(name, []).append((rid, sender,
+                                                       "create"))
+            if rec is None:
+                self._propose(grp, {
+                    "op": "create", "name": name,
+                    "actives": self.ch_active.replicated_servers(
+                        name, self.k_active),
+                    "init": b.get("init", "")})
+            return
+        if t == rc.DELETE_NAME:
+            if rec is None:
+                self.node._route(sender, pkt.Control(
+                    self.id, rc.reply(rid, False, err="nonexistent")))
+                return
+            self._pending.setdefault(name, []).append((rid, sender,
+                                                       "delete"))
+            if rec.state == READY:
+                self._propose(grp, {"op": "delete", "name": name})
+            return
+        if t == rc.MOVE_NAME:
+            if rec is None or rec.state != READY:
+                self.node._route(sender, pkt.Control(
+                    self.id, rc.reply(rid, False, err="not-ready")))
+                return
+            bad = set(b["new_actives"]) - set(self.actives)
+            if bad or not b["new_actives"]:
+                # reject unknown/empty targets up front — once committed,
+                # an unreachable active set would wedge WAIT_ACK_START
+                self.node._route(sender, pkt.Control(
+                    self.id, rc.reply(rid, False,
+                                      err=f"bad actives: {sorted(bad)}")))
+                return
+            if sorted(b["new_actives"]) == sorted(rec.actives):
+                self.node._route(sender, pkt.Control(
+                    self.id, rc.reply(rid, True, rec.actives)))
+                return
+            self._pending.setdefault(name, []).append((rid, sender, "move"))
+            self._propose(grp, {"op": "move", "name": name,
+                                "new_actives": list(b["new_actives"])})
+
+    # -- acks from actives -------------------------------------------------
+
+    def _on_ack_start(self, sender: int, b: dict) -> None:
+        name, epoch = b["name"], b["epoch"]
+        rec = self.db.lookup(self.group_of(name), name)
+        if rec is None or rec.state != WAIT_ACK_START or rec.epoch != epoch:
+            return
+        acks = self._acks_start.setdefault((name, epoch), set())
+        acks.add(sender)
+        if len(acks & set(rec.new_actives)) >= \
+                len(rec.new_actives) // 2 + 1:
+            self._propose(self.group_of(name),
+                          {"op": "ready", "name": name, "epoch": epoch})
+
+    def _on_ack_stop(self, sender: int, b: dict) -> None:
+        name, epoch = b["name"], b["epoch"]
+        rec = self.db.lookup(self.group_of(name), name)
+        if rec is None or rec.state != WAIT_ACK_STOP or epoch < rec.epoch:
+            return
+        if b.get("final"):
+            self._final[(name, rec.epoch)] = b["final"]
+        final = self._final.get((name, rec.epoch))
+        if rec.deleting:
+            # one committed-stop ack suffices: the stop was decided by the
+            # group itself, so it is durable at a majority already
+            self._propose(self.group_of(name),
+                          {"op": "dropped", "name": name})
+        elif final is not None:
+            self._propose(self.group_of(name),
+                          {"op": "start_next", "name": name, "init": final})
+
+    def _on_demand(self, sender: int, b: dict) -> None:
+        if self.demand_policy is None:
+            return
+        for name, cnt in b.get("reports", {}).items():
+            grp = self.group_of(name)
+            if self.id not in self.group_members(grp):
+                # not our record: forward the report to the owning group
+                # (actives report by active id, not by record owner)
+                self.node._route(self._live_member(grp), pkt.Control(
+                    self.id, rc.demand({name: int(cnt)})))
+                continue
+            total = self._demand.get(name, 0) + int(cnt)
+            self._demand[name] = total
+            rec = self.db.lookup(grp, name)
+            if rec is None or rec.state != READY:
+                continue
+            new = self.demand_policy(name, total, list(rec.actives),
+                                     list(self.actives))
+            if new and sorted(new) != sorted(rec.actives):
+                self._demand[name] = 0
+                self._propose(grp, {"op": "move", "name": name,
+                                    "new_actives": list(new)})
+
+    # -- committed-record side effects (worker thread, every member) -------
+
+    def _on_commit(self, rc_group: str, cmd: dict,
+                   rec: Optional[RCRecord]) -> None:
+        if rec is None:
+            return  # stale/duplicate op: first application already acted
+        op = cmd["op"]
+        name = rec.name
+        if op in ("create", "start_next"):
+            self._send_start_epoch(rec)
+        elif op == "ready":
+            self._acks_start.pop((name, rec.epoch), None)
+            self._final.pop((name, rec.epoch - 1), None)
+            # retire the previous epoch's replicas (ref:
+            # DropEpochFinalState after the new epoch is READY)
+            for a in rec.prev_actives:
+                self.node._route(a, pkt.Control(
+                    self.id, rc.drop_epoch(name, rec.epoch - 1)))
+            rec.prev_actives = []
+            self._flush_pending(name, ("create", "move"), True, rec.actives)
+        elif op in ("delete", "move"):
+            self._send_stop_epoch(rec)
+        elif op == "dropped":
+            for a in rec.actives:
+                self.node._route(a, pkt.Control(
+                    self.id, rc.drop_epoch(name, rec.epoch)))
+            self._final.pop((name, rec.epoch), None)
+            self._flush_pending(name, ("delete",), True, [])
+
+    def _flush_pending(self, name: str, kinds: Tuple[str, ...], ok: bool,
+                       actives: List[int]) -> None:
+        left = []
+        for rid, client, kind in self._pending.pop(name, []):
+            if kind in kinds:
+                self.node._route(client, pkt.Control(
+                    self.id, rc.reply(rid, ok, actives)))
+            else:
+                left.append((rid, client, kind))
+        if left:
+            self._pending[name] = left
+
+    def _send_start_epoch(self, rec: RCRecord) -> None:
+        for a in rec.new_actives:
+            self.node._route(a, pkt.Control(self.id, rc.start_epoch(
+                rec.name, rec.epoch, rec.new_actives, rec.init_b64)))
+
+    def _send_stop_epoch(self, rec: RCRecord) -> None:
+        for a in rec.actives:
+            self.node._route(a, pkt.Control(
+                self.id, rc.stop_epoch(rec.name, rec.epoch)))
+
+    # -- retries (worker thread) -------------------------------------------
+
+    def _tick(self) -> None:
+        now = time.time()
+        if now - self._last_retry < self.retry_s:
+            return
+        self._last_retry = now
+        # GC stale relay entries (client long gone by 60s)
+        cutoff = now - 60
+        self._relay = {rid: v for rid, v in self._relay.items()
+                       if v[1] > cutoff}
+        for grp in self.my_groups():
+            for rec in list(self.db.groups.get(grp, {}).values()):
+                if rec.state == WAIT_ACK_START:
+                    self._send_start_epoch(rec)
+                elif rec.state == WAIT_ACK_STOP:
+                    self._send_stop_epoch(rec)

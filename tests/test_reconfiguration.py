@@ -1,0 +1,222 @@
+"""Reconfiguration control-plane tests.
+
+Ref: ``reconfiguration/testing/TESTReconfigurationMain/Client`` (SURVEY.md
+§4.4): name creates/deletes, RequestActiveReplicas correctness, epoch churn
+(moves) with state carried across epochs — all single-process multi-node on
+real loopback sockets.
+"""
+
+import asyncio
+import socket
+import time
+
+import pytest
+
+from gigapaxos_tpu.paxos.interfaces import KVApp
+from gigapaxos_tpu.paxos.paxosconfig import PC
+from gigapaxos_tpu.reconfiguration import (ConsistentHashing,
+                                           ReconfigurableAppClient,
+                                           ReconfigurableNode)
+from gigapaxos_tpu.reconfiguration.node import NodeConfig
+from gigapaxos_tpu.reconfiguration.rcdb import (READY, WAIT_ACK_START,
+                                                ReconfiguratorDB)
+from gigapaxos_tpu.utils.config import Config
+
+
+def free_ports(n):
+    socks = [socket.socket() for _ in range(n)]
+    for s in socks:
+        s.bind(("127.0.0.1", 0))
+    ports = [s.getsockname()[1] for s in socks]
+    for s in socks:
+        s.close()
+    return ports
+
+
+def make_cluster(tmp_path, n_active=3, n_rc=3):
+    Config.set(PC.SYNC_WAL, False)
+    Config.set(PC.PING_INTERVAL_S, 0.05)
+    ports = free_ports(n_active + n_rc)
+    cfg = NodeConfig(
+        actives={i: ("127.0.0.1", ports[i]) for i in range(n_active)},
+        reconfigurators={100 + i: ("127.0.0.1", ports[n_active + i])
+                         for i in range(n_rc)},
+        actives_per_name=min(3, n_active))
+    nodes = [ReconfigurableNode(i, cfg, KVApp, str(tmp_path),
+                                capacity=1 << 10, window=16)
+             for i in list(cfg.actives) + list(cfg.reconfigurators)]
+    for nd in nodes:
+        nd.start()
+    return nodes, cfg
+
+
+def shutdown(nodes):
+    for nd in nodes:
+        nd.stop()
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+# ---------------------------------------------------------------------------
+# unit: consistent hashing + record FSM
+# ---------------------------------------------------------------------------
+
+
+def test_consistent_hashing_balance_and_stability():
+    ch = ConsistentHashing([1, 2, 3, 4, 5])
+    names = [f"name{i}" for i in range(2000)]
+    owners = {n: ch.server(n) for n in names}
+    counts = {}
+    for o in owners.values():
+        counts[o] = counts.get(o, 0) + 1
+    assert set(counts) == {1, 2, 3, 4, 5}
+    assert min(counts.values()) > 100  # roughly balanced
+    # k successors are distinct
+    for n in names[:50]:
+        ks = ch.replicated_servers(n, 3)
+        assert len(ks) == len(set(ks)) == 3
+    # removing one node moves only its names
+    ch2 = ConsistentHashing([1, 2, 3, 4])
+    moved = sum(1 for n in names
+                if owners[n] != 5 and ch2.server(n) != owners[n])
+    assert moved < len(names) * 0.05
+
+
+def test_rcdb_fsm():
+    db = ReconfiguratorDB()
+    ops = []
+    db.on_commit = lambda g, c, r: ops.append((c["op"], r))
+    g = "_RC_1"
+
+    def do(cmd):
+        return db.execute(g, 0, __import__("json").dumps(cmd).encode())
+
+    do({"op": "create", "name": "svc", "actives": [1, 2, 3]})
+    rec = db.lookup(g, "svc")
+    assert rec.state == WAIT_ACK_START and rec.epoch == 0
+    # duplicate create is a stale no-op
+    do({"op": "create", "name": "svc", "actives": [4, 5]})
+    assert ops[-1][1] is None
+    do({"op": "ready", "name": "svc", "epoch": 0})
+    assert db.lookup(g, "svc").state == READY
+    # move: stop -> start_next(epoch+1) -> ready
+    do({"op": "move", "name": "svc", "new_actives": [2, 3, 4]})
+    do({"op": "start_next", "name": "svc", "init": ""})
+    rec = db.lookup(g, "svc")
+    assert rec.epoch == 1 and rec.state == WAIT_ACK_START
+    assert rec.prev_actives == [1, 2, 3]
+    do({"op": "ready", "name": "svc", "epoch": 1})
+    assert db.lookup(g, "svc").actives == [2, 3, 4]
+    # delete: stop -> dropped removes the record
+    do({"op": "delete", "name": "svc"})
+    do({"op": "dropped", "name": "svc"})
+    assert db.lookup(g, "svc") is None
+    # checkpoint/restore round trip
+    do({"op": "create", "name": "svc2", "actives": [1, 2]})
+    state = db.checkpoint(g)
+    db2 = ReconfiguratorDB()
+    db2.restore(g, state)
+    assert db2.lookup(g, "svc2").actives == [1, 2]
+
+
+# ---------------------------------------------------------------------------
+# e2e: create / request / actives / delete / move
+# ---------------------------------------------------------------------------
+
+
+def test_create_request_delete(tmp_path):
+    nodes, cfg = make_cluster(tmp_path)
+    try:
+        async def body():
+            cli = ReconfigurableAppClient(1 << 16, cfg, timeout=10)
+            try:
+                assert await cli.create("svcA", b"")
+                actives = await cli.get_actives("svcA")
+                assert len(actives) == 3
+                r = await cli.send_request(
+                    "svcA", b'{"op":"put","k":"x","v":"1"}')
+                assert b"ok" in r
+                r = await cli.send_request("svcA", b'{"op":"get","k":"x"}')
+                assert b'"1"' in r
+                # idempotent re-create
+                assert await cli.create("svcA", b"")
+                # delete, then lookups fail
+                assert await cli.delete("svcA")
+                with pytest.raises(KeyError):
+                    await cli.get_actives("svcA")
+                # deleting again reports nonexistent
+                assert not await cli.delete("svcA")
+                # name is reusable after delete (fresh state)
+                assert await cli.create("svcA", b"")
+                r = await cli.send_request("svcA", b'{"op":"get","k":"x"}')
+                assert b"null" in r
+            finally:
+                await cli.close()
+        run(body())
+    finally:
+        shutdown(nodes)
+
+
+def test_many_creates(tmp_path):
+    nodes, cfg = make_cluster(tmp_path)
+    try:
+        async def body():
+            cli = ReconfigurableAppClient(1 << 16, cfg, timeout=15)
+            try:
+                names = [f"svc{i}" for i in range(20)]
+                oks = await asyncio.gather(
+                    *[cli.create(n, b"") for n in names])
+                assert all(oks)
+                outs = await asyncio.gather(*[
+                    cli.send_request(n, b'{"op":"put","k":"k","v":"v"}')
+                    for n in names])
+                assert all(b"ok" in o for o in outs)
+            finally:
+                await cli.close()
+        run(body())
+    finally:
+        shutdown(nodes)
+
+
+def test_move_preserves_state(tmp_path):
+    nodes, cfg = make_cluster(tmp_path, n_active=4)
+    try:
+        async def body():
+            cli = ReconfigurableAppClient(1 << 16, cfg, timeout=15)
+            try:
+                assert await cli.create("mv", b"")
+                old = sorted(await cli.get_actives("mv"))
+                for i in range(5):
+                    await cli.send_request(
+                        "mv", f'{{"op":"put","k":"k{i}","v":"{i}"}}'
+                        .encode())
+                new = sorted(set(range(4)) - set(old)) + old[:2]
+                assert await cli.move("mv", new)
+                got = sorted(await cli.get_actives("mv"))
+                assert got == sorted(new)
+                # state survived the epoch change
+                for i in range(5):
+                    r = await cli.send_request(
+                        "mv", f'{{"op":"get","k":"k{i}"}}'.encode())
+                    assert f'"{i}"'.encode() in r, r
+                # writes still replicate in the new epoch
+                r = await cli.send_request(
+                    "mv", b'{"op":"put","k":"post","v":"yes"}')
+                assert b"ok" in r
+                # the active dropped from the group no longer hosts it
+                dropped = set(old) - set(new)
+                deadline = time.time() + 10
+                while dropped and time.time() < deadline:
+                    if all(nodes[d].active.node.table.by_name("mv") is None
+                           for d in dropped):
+                        break
+                    await asyncio.sleep(0.1)
+                for d in dropped:
+                    assert nodes[d].active.node.table.by_name("mv") is None
+            finally:
+                await cli.close()
+        run(body())
+    finally:
+        shutdown(nodes)
