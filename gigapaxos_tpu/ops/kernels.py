@@ -65,6 +65,28 @@ def _si(g, valid, G):
     return jnp.where(valid, g, G)
 
 
+def _run_rank(key1, key2):
+    """Rank of each lane within its equal-(key1, key2) run, in original
+    lane order.
+
+    O(B log B) stable-sort formulation of "occurrence index among lanes
+    with the same key" — replaces the naive [B, B] pairwise comparison,
+    which materializes/streams a B² boolean matrix and dominated step time
+    for B beyond a few thousand.  Two i32 keys (lexsorted) because x64 is
+    disabled, so a packed 64-bit key would silently truncate.
+    """
+    B = key1.shape[0]
+    order = jnp.lexsort((key2, key1))  # stable: equal pairs in lane order
+    k1, k2 = key1[order], key2[order]
+    iota = jnp.arange(B, dtype=i32)
+    start = jnp.concatenate(
+        [jnp.ones((1,), jnp.bool_),
+         (k1[1:] != k1[:-1]) | (k2[1:] != k2[:-1])])
+    run_start = jax.lax.cummax(jnp.where(start, iota, 0))
+    rank_sorted = iota - run_start
+    return jnp.zeros((B,), i32).at[order].set(rank_sorted)
+
+
 # --------------------------------------------------------------------------
 # accept (acceptor side)                                  ref: PaxosAcceptor
 # --------------------------------------------------------------------------
@@ -160,10 +182,11 @@ def accept_reply_batch(state: ColumnarState, g, slot, bal, sender, acked,
     # Exactly-once emission: besides the cross-batch `emitted` flag, dedupe
     # WITHIN the batch — when two replies for the same (group, slot) cross
     # quorum in one batch, only the first lane emits the decision.
-    same = (g[None, :] == g[:, None]) & (slot[None, :] == slot[:, None]) & \
-        quorum[None, :] & quorum[:, None]
-    lower = jnp.tril(jnp.ones((g.shape[0],) * 2, jnp.bool_), k=-1)
-    dup_before = jnp.any(same & lower, axis=1)
+    # Non-quorum lanes get unique sentinel keys so they never form runs.
+    B = g.shape[0]
+    iota = jnp.arange(B, dtype=i32)
+    dup_before = quorum & (_run_rank(jnp.where(quorum, g, -1),
+                                     jnp.where(quorum, slot, iota)) > 0)
     newly = quorum & ~state.emitted[gi, w] & ~dup_before
     emitted = state.emitted.at[_si(g, newly, G), w].set(True, mode="drop")
 
@@ -204,9 +227,8 @@ def propose_batch(state: ColumnarState, g, rlo, rhi, valid):
     """Assign contiguous slots to new requests, multiple per group per batch.
 
     Lane i's slot is ``next_slot[g] + rank_i`` where rank is the lane's
-    occurrence index among same-group lanes (an O(B^2) bool reduction —
-    fine for B ≤ a few thousand on the MXU; replace with a sort-based rank
-    if B grows).
+    occurrence index among same-group lanes (stable-sort run rank,
+    O(B log B) — see :func:`_run_rank`).
     """
     G, W = state.G, state.W
     B = g.shape[0]
@@ -215,9 +237,8 @@ def propose_batch(state: ColumnarState, g, rlo, rhi, valid):
     can = valid & state.active[gi] & state.is_coord[gi] & \
         state.coord_active[gi]
 
-    same = (g[None, :] == g[:, None]) & can[None, :] & can[:, None]
-    lower = jnp.tril(jnp.ones((B, B), jnp.bool_), k=-1)
-    rank = jnp.sum(same & lower, axis=1).astype(i32)
+    iota = jnp.arange(B, dtype=i32)
+    rank = _run_rank(jnp.where(can, g, -1), jnp.where(can, 0, iota))
 
     slot = state.next_slot[gi] + rank
     in_win = slot < state.exec_cursor[gi] + W
