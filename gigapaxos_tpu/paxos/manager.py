@@ -702,8 +702,14 @@ class PaxosNode:
                     self._group_stopped.add(row)
             self.n_executed += 1
             self._proposed.discard(req_id)
-            self._executed_recent[req_id] = time.time()
-            self._resp_cache[req_id] = resp
+            if status == 0:
+                # only APPLIED requests enter the at-most-once dedup
+                # tables; a stop-skipped request (status 3) must stay
+                # retryable in the next epoch — caching it would answer a
+                # retransmit with status 0 and an empty payload, i.e. a
+                # silently "successful" lost write
+                self._executed_recent[req_id] = time.time()
+                self._resp_cache[req_id] = resp
             waiter = self._client_wait.pop(req_id, None)
             if waiter is not None:
                 self._route(waiter[0], pkt.Response(

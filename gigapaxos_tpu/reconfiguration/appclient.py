@@ -40,6 +40,7 @@ class ReconfigurableAppClient:
         self._seq = itertools.count(1)
         self._conns: Dict[int, Tuple[asyncio.StreamReader,
                                      asyncio.StreamWriter]] = {}
+        self._conn_locks: Dict[int, asyncio.Lock] = {}
         self._read_tasks: Dict[int, asyncio.Task] = {}
         self._waiting: Dict[int, asyncio.Future] = {}
         self._actives_cache: Dict[str, List[int]] = {}
@@ -55,13 +56,20 @@ class ReconfigurableAppClient:
         c = self._conns.get(node)
         if c is not None and not c[1].is_closing():
             return c
-        host, port = self.config.addr_map[node]
-        reader, writer = await asyncio.open_connection(host, port)
-        writer.write(_LEN.pack(4) + struct.pack("<i", self.id))
-        self._conns[node] = (reader, writer)
-        self._read_tasks[node] = asyncio.get_running_loop().create_task(
-            self._read_loop(node, reader))
-        return reader, writer
+        # per-node lock: without it, concurrent first requests each open a
+        # connection and all but the last socket/read-task leak
+        lock = self._conn_locks.setdefault(node, asyncio.Lock())
+        async with lock:
+            c = self._conns.get(node)
+            if c is not None and not c[1].is_closing():
+                return c
+            host, port = self.config.addr_map[node]
+            reader, writer = await asyncio.open_connection(host, port)
+            writer.write(_LEN.pack(4) + struct.pack("<i", self.id))
+            self._conns[node] = (reader, writer)
+            self._read_tasks[node] = asyncio.get_running_loop().create_task(
+                self._read_loop(node, reader))
+            return reader, writer
 
     async def _read_loop(self, node: int, reader: asyncio.StreamReader):
         try:
@@ -82,7 +90,9 @@ class ReconfigurableAppClient:
                         fut.set_result(obj)
         except (asyncio.IncompleteReadError, ConnectionError, OSError,
                 asyncio.CancelledError):
-            self._conns.pop(node, None)
+            c = self._conns.pop(node, None)
+            if c is not None:
+                c[1].close()
 
     async def _rpc(self, node: int, rid: int, frame: bytes):
         _, writer = await self._conn(node)

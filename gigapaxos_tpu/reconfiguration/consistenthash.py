@@ -9,13 +9,11 @@ ring with virtual nodes so that churn in the node set moves few names.
 from __future__ import annotations
 
 import bisect
-import hashlib
 from typing import List, Sequence, Tuple
 
-
-def _h(s: str) -> int:
-    return int.from_bytes(
-        hashlib.blake2b(s.encode(), digest_size=8).digest(), "big")
+# one name-hash primitive for the whole framework (byte order is
+# irrelevant for ring placement)
+from gigapaxos_tpu.paxos.packets import group_key as _h
 
 
 class ConsistentHashing:

@@ -173,8 +173,8 @@ class Reconfigurator:
                 self.node._route(sender, pkt.Control(
                     self.id, rc.reply(rid, True, rec.actives)))
                 return
-            self._pending.setdefault(name, []).append((rid, sender,
-                                                       "create"))
+            self._pending.setdefault(name, []).append(
+                (rid, sender, "create", b, time.time()))
             if rec is None:
                 self._propose(grp, {
                     "op": "create", "name": name,
@@ -187,8 +187,8 @@ class Reconfigurator:
                 self.node._route(sender, pkt.Control(
                     self.id, rc.reply(rid, False, err="nonexistent")))
                 return
-            self._pending.setdefault(name, []).append((rid, sender,
-                                                       "delete"))
+            self._pending.setdefault(name, []).append(
+                (rid, sender, "delete", b, time.time()))
             if rec.state == READY:
                 self._propose(grp, {"op": "delete", "name": name})
             return
@@ -209,7 +209,8 @@ class Reconfigurator:
                 self.node._route(sender, pkt.Control(
                     self.id, rc.reply(rid, True, rec.actives)))
                 return
-            self._pending.setdefault(name, []).append((rid, sender, "move"))
+            self._pending.setdefault(name, []).append(
+                (rid, sender, "move", b, time.time()))
             self._propose(grp, {"op": "move", "name": name,
                                 "new_actives": list(b["new_actives"])})
 
@@ -296,17 +297,24 @@ class Reconfigurator:
             self._final.pop((name, rec.epoch), None)
             self._flush_pending(name, ("delete",), True, [])
 
+    _KIND_TYPE = {"create": rc.CREATE_NAME, "delete": rc.DELETE_NAME,
+                  "move": rc.MOVE_NAME}
+
     def _flush_pending(self, name: str, kinds: Tuple[str, ...], ok: bool,
                        actives: List[int]) -> None:
         left = []
-        for rid, client, kind in self._pending.pop(name, []):
+        for rid, client, kind, b, ts in self._pending.pop(name, []):
             if kind in kinds:
                 self.node._route(client, pkt.Control(
                     self.id, rc.reply(rid, ok, actives)))
             else:
-                left.append((rid, client, kind))
-        if left:
-            self._pending[name] = left
+                left.append((rid, client, kind, b, ts))
+        # re-drive ops pended while the record was in a non-matching FSM
+        # state (e.g. a DELETE that arrived during WAIT_ACK_START): the
+        # flush marks a state transition, so run them through _client_op
+        # again — they either proceed now or re-pend for the next one
+        for rid, client, kind, b, _ts in left:
+            self._client_op(client, self._KIND_TYPE[kind], b)
 
     def _send_start_epoch(self, rec: RCRecord) -> None:
         for a in rec.new_actives:
@@ -329,6 +337,11 @@ class Reconfigurator:
         cutoff = now - 60
         self._relay = {rid: v for rid, v in self._relay.items()
                        if v[1] > cutoff}
+        # abandoned client ops (client stopped retrying) must not pin
+        # _pending forever
+        self._pending = {
+            n: kept for n, es in self._pending.items()
+            if (kept := [e for e in es if e[4] > cutoff])}
         for grp in self.my_groups():
             for rec in list(self.db.groups.get(grp, {}).values()):
                 if rec.state == WAIT_ACK_START:

@@ -220,3 +220,34 @@ def test_move_preserves_state(tmp_path):
         run(body())
     finally:
         shutdown(nodes)
+
+
+def test_concurrent_create_then_immediate_delete(tmp_path):
+    """A DELETE that lands while the CREATE's epoch FSM is still in
+    WAIT_ACK_START must be pended and re-driven when the record reaches
+    READY — not dropped (review finding: pended ops of a non-matching
+    kind were never flushed)."""
+    nodes, cfg = make_cluster(tmp_path)
+    try:
+        async def body():
+            cli = ReconfigurableAppClient(1 << 16, cfg, timeout=15)
+            try:
+                create_t = asyncio.create_task(cli.create("svcX", b""))
+                # race the delete against the in-flight create
+                delete_t = asyncio.create_task(cli.delete("svcX"))
+                created, deleted = await asyncio.gather(
+                    create_t, delete_t, return_exceptions=True)
+                assert created is True, created
+                # delete either won the race after READY (True) or saw
+                # the record before the create committed (False:
+                # "nonexistent"); a TimeoutError means it was dropped
+                assert isinstance(deleted, bool), deleted
+                if deleted:
+                    with pytest.raises(KeyError):
+                        await cli.get_actives("svcX")
+                    assert await cli.create("svcX", b"")
+            finally:
+                await cli.close()
+        run(body())
+    finally:
+        shutdown(nodes)
