@@ -1,0 +1,357 @@
+"""Native host hot path (C++ via ctypes) with pure-Python fallbacks.
+
+See ``hotpath.cc`` for what runs native and why (ref analogs:
+``nio/MessageExtractor``, ``paxospackets`` byteification,
+``utils/MultiArrayMap``/``paxosutil/IntegerMap``).  The module compiles
+itself with ``g++`` on first import and caches the ``.so`` next to the
+source; set ``GP_NO_NATIVE=1`` to force the Python fallbacks (used by
+tests to check parity).
+
+Public surface:
+
+- ``HAVE_NATIVE``: bool
+- ``scan_frames(buf) -> (offs, lens, consumed)``
+- ``parse_requests(buf, offs, lens) -> (sender, gkey, req_id, flags,
+  pay_off, pay)``
+- ``encode_responses(sender, gkey, req_id, status, payloads) -> bytes``
+  (pre-framed: ready to write to a socket as-is)
+- ``coalesce_max(row, slot, bal) -> keep`` (bool mask)
+- ``KeyRowMap``: u64 -> i32 map with ``put/get/delete/get_batch``
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from gigapaxos_tpu.utils.logutil import get_logger
+
+log = get_logger("gp.native")
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "hotpath.cc")
+_SO = os.path.join(_DIR, "_hotpath.so")
+
+_lib: Optional[ctypes.CDLL] = None
+_build_lock = threading.Lock()
+
+
+def _build() -> Optional[str]:
+    """Compile hotpath.cc -> _hotpath.so if stale; return path or None."""
+    try:
+        if (os.path.exists(_SO)
+                and os.path.getmtime(_SO) >= os.path.getmtime(_SRC)):
+            return _SO
+        tmp = _SO + f".tmp.{os.getpid()}"
+        subprocess.run(
+            ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+             "-o", tmp, _SRC],
+            check=True, capture_output=True, timeout=120)
+        os.replace(tmp, _SO)  # atomic under concurrent builders
+        return _SO
+    except (OSError, subprocess.SubprocessError) as e:
+        log.warning("native build unavailable (%s); using Python fallback",
+                    e)
+        return None
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib
+    if _lib is not None:
+        return _lib
+    if os.environ.get("GP_NO_NATIVE"):
+        return None
+    with _build_lock:
+        if _lib is not None:
+            return _lib
+        so = _build()
+        if so is None:
+            return None
+        lib = ctypes.CDLL(so)
+        i64, u64p = ctypes.c_int64, ctypes.POINTER(ctypes.c_uint64)
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        u32p = ctypes.POINTER(ctypes.c_uint32)
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        lib.gp_scan_frames.restype = i64
+        lib.gp_scan_frames.argtypes = [u8p, i64, i64, i64, i64p, i64p,
+                                       i64p]
+        lib.gp_parse_requests.restype = i64
+        lib.gp_parse_requests.argtypes = [u8p, i64p, i64p, i64, u32p, u64p,
+                                          u64p, u8p, i64p, u8p, i64]
+        lib.gp_encode_responses.restype = i64
+        lib.gp_encode_responses.argtypes = [ctypes.c_uint32, i64, u64p,
+                                            u64p, u8p, i64p, u8p, u8p, i64]
+        lib.gp_coalesce_max.restype = i64
+        lib.gp_coalesce_max.argtypes = [i32p, i32p, i32p, i64, u8p]
+        lib.gp_map_new.restype = ctypes.c_void_p
+        lib.gp_map_new.argtypes = [i64]
+        lib.gp_map_free.argtypes = [ctypes.c_void_p]
+        lib.gp_map_put.restype = i64
+        lib.gp_map_put.argtypes = [ctypes.c_void_p, ctypes.c_uint64,
+                                   ctypes.c_int32]
+        lib.gp_map_get_batch.argtypes = [ctypes.c_void_p, u64p, i64, i32p,
+                                         ctypes.c_int32]
+        lib.gp_map_del.restype = i64
+        lib.gp_map_del.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+        lib.gp_map_size.restype = i64
+        lib.gp_map_size.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return _lib
+
+
+def _p(a: np.ndarray, ctype):
+    return a.ctypes.data_as(ctypes.POINTER(ctype))
+
+
+MAX_FRAME = 64 * 1024 * 1024
+_REQ_HDR = 1 + 4 + 4 + 8 + 8 + 1
+
+
+# --------------------------------------------------------------------------
+# scan_frames
+# --------------------------------------------------------------------------
+
+
+def scan_frames(buf: bytes | bytearray | memoryview
+                ) -> Tuple[np.ndarray, np.ndarray, int]:
+    """Split a length-prefixed stream into frame (offset, length) arrays
+    plus the count of consumed bytes.  Raises ValueError on an oversized
+    frame (protocol violation)."""
+    lib = _load()
+    n = len(buf)
+    cap = max(1, n // 4)
+    if lib is not None:
+        arr = np.frombuffer(buf, np.uint8)
+        offs = np.empty(cap, np.int64)
+        lens = np.empty(cap, np.int64)
+        consumed = ctypes.c_int64(0)
+        cnt = lib.gp_scan_frames(
+            _p(arr, ctypes.c_uint8), n, cap, MAX_FRAME,
+            _p(offs, ctypes.c_int64), _p(lens, ctypes.c_int64),
+            ctypes.byref(consumed))
+        if cnt < 0:
+            raise ValueError("oversized frame")
+        return offs[:cnt], lens[:cnt], consumed.value
+    # fallback
+    mv = memoryview(buf)
+    offs_l, lens_l, pos = [], [], 0
+    while pos + 4 <= n:
+        ln = int.from_bytes(mv[pos:pos + 4], "little")
+        if ln > MAX_FRAME:
+            raise ValueError("oversized frame")
+        if pos + 4 + ln > n:
+            break
+        offs_l.append(pos + 4)
+        lens_l.append(ln)
+        pos += 4 + ln
+    return (np.asarray(offs_l, np.int64), np.asarray(lens_l, np.int64),
+            pos)
+
+
+# --------------------------------------------------------------------------
+# parse_requests
+# --------------------------------------------------------------------------
+
+
+def parse_requests(buf, offs: np.ndarray, lens: np.ndarray):
+    """Parse REQUEST frames (at ``offs/lens`` within ``buf``) into SoA:
+    ``(sender u32[n], gkey u64[n], req_id u64[n], flags u8[n],
+    pay_off i64[n+1], pay bytes)``."""
+    n = len(offs)
+    lib = _load()
+    if lib is not None and n:
+        arr = np.frombuffer(buf, np.uint8)
+        offs = np.ascontiguousarray(offs, np.int64)
+        lens = np.ascontiguousarray(lens, np.int64)
+        sender = np.empty(n, np.uint32)
+        gkey = np.empty(n, np.uint64)
+        req_id = np.empty(n, np.uint64)
+        flags = np.empty(n, np.uint8)
+        pay_off = np.empty(n + 1, np.int64)
+        cap = int(lens.sum())  # payloads are subsets of the frames
+        pay = np.empty(max(cap, 1), np.uint8)
+        rc = lib.gp_parse_requests(
+            _p(arr, ctypes.c_uint8), _p(offs, ctypes.c_int64),
+            _p(lens, ctypes.c_int64), n, _p(sender, ctypes.c_uint32),
+            _p(gkey, ctypes.c_uint64), _p(req_id, ctypes.c_uint64),
+            _p(flags, ctypes.c_uint8), _p(pay_off, ctypes.c_int64),
+            _p(pay, ctypes.c_uint8), len(pay))
+        if rc != 0:
+            raise ValueError(f"malformed request frame (rc={rc})")
+        return (sender, gkey, req_id, flags, pay_off,
+                pay[:int(pay_off[n])].tobytes())
+    # fallback
+    import struct
+    mv = memoryview(buf)
+    sender = np.empty(n, np.uint32)
+    gkey = np.empty(n, np.uint64)
+    req_id = np.empty(n, np.uint64)
+    flags = np.empty(n, np.uint8)
+    pay_off = np.zeros(n + 1, np.int64)
+    chunks: List[bytes] = []
+    w = 0
+    for i in range(n):
+        o, ln = int(offs[i]), int(lens[i])
+        if ln < _REQ_HDR:
+            raise ValueError("malformed request frame")
+        f = mv[o:o + ln]
+        sender[i] = struct.unpack_from("<I", f, 1)[0]
+        gkey[i], req_id[i] = struct.unpack_from("<QQ", f, 9)
+        flags[i] = f[25]
+        chunks.append(bytes(f[_REQ_HDR:]))
+        w += ln - _REQ_HDR
+        pay_off[i + 1] = w
+    return sender, gkey, req_id, flags, pay_off, b"".join(chunks)
+
+
+# --------------------------------------------------------------------------
+# encode_responses
+# --------------------------------------------------------------------------
+
+
+def encode_responses(sender: int, gkey: np.ndarray, req_id: np.ndarray,
+                     status: np.ndarray,
+                     payloads: Sequence[bytes]) -> bytes:
+    """Encode n Response frames into ONE pre-framed buffer (each frame
+    length-prefixed) for a single socket write."""
+    n = len(gkey)
+    lib = _load()
+    if lib is not None and n:
+        gkey = np.ascontiguousarray(gkey, np.uint64)
+        req_id = np.ascontiguousarray(req_id, np.uint64)
+        status = np.ascontiguousarray(status, np.uint8)
+        pay_off = np.zeros(n + 1, np.int64)
+        np.cumsum([len(p) for p in payloads], out=pay_off[1:])
+        pay = np.frombuffer(b"".join(payloads), np.uint8) if pay_off[n] \
+            else np.empty(1, np.uint8)
+        cap = int(pay_off[n]) + n * (4 + _REQ_HDR)
+        out = np.empty(cap, np.uint8)
+        w = lib.gp_encode_responses(
+            sender, n, _p(gkey, ctypes.c_uint64),
+            _p(req_id, ctypes.c_uint64), _p(status, ctypes.c_uint8),
+            _p(pay_off, ctypes.c_int64), _p(pay, ctypes.c_uint8),
+            _p(out, ctypes.c_uint8), cap)
+        if w < 0:
+            raise ValueError("encode_responses: buffer overflow")
+        return out[:w].tobytes()
+    # fallback
+    import struct
+    parts = []
+    for i in range(n):
+        body = (bytes([2]) + struct.pack("<II", sender, 1) +
+                struct.pack("<QQB", int(gkey[i]), int(req_id[i]),
+                            int(status[i])) + payloads[i])
+        parts.append(struct.pack("<I", len(body)) + body)
+    return b"".join(parts)
+
+
+# --------------------------------------------------------------------------
+# coalesce_max
+# --------------------------------------------------------------------------
+
+
+def coalesce_max(row: np.ndarray, slot: np.ndarray,
+                 bal: np.ndarray) -> np.ndarray:
+    """Bool mask keeping, per (row, slot), the highest-ballot lane (first
+    occurrence on ties); negative rows dropped."""
+    n = len(row)
+    lib = _load()
+    if lib is not None and n:
+        row = np.ascontiguousarray(row, np.int32)
+        slot = np.ascontiguousarray(slot, np.int32)
+        bal = np.ascontiguousarray(bal, np.int32)
+        keep = np.empty(n, np.uint8)
+        kept = lib.gp_coalesce_max(
+            _p(row, ctypes.c_int32), _p(slot, ctypes.c_int32),
+            _p(bal, ctypes.c_int32), n, _p(keep, ctypes.c_uint8))
+        if kept < 0:
+            raise MemoryError("coalesce_max")
+        return keep.astype(bool)
+    best: dict = {}
+    for i in range(n):
+        if row[i] < 0:
+            continue
+        k = (int(row[i]), int(slot[i]))
+        if k not in best or int(bal[i]) > int(bal[best[k]]):
+            best[k] = i
+    keep = np.zeros(n, bool)
+    for i in best.values():
+        keep[i] = True
+    return keep
+
+
+# --------------------------------------------------------------------------
+# KeyRowMap
+# --------------------------------------------------------------------------
+
+
+class KeyRowMap:
+    """u64 gkey -> i32 device row (ref: ``MultiArrayMap``/``IntegerMap``).
+
+    Native open-addressing map when available, else a dict.  ``get_batch``
+    is the hot call: one C call for a whole packet batch.
+    """
+
+    MISSING = -1
+
+    def __init__(self, cap_hint: int = 1024):
+        self._lib = _load()
+        self._h = None
+        self._d: Optional[dict] = None
+        if self._lib is not None:
+            self._h = self._lib.gp_map_new(cap_hint)
+        if self._h is None:
+            self._d = {}
+
+    def put(self, key: int, row: int) -> None:
+        if self._d is not None:
+            self._d[key] = row
+        elif self._lib.gp_map_put(self._h, key, row) != 0:
+            raise MemoryError("gp_map_put")
+
+    def get(self, key: int) -> int:
+        if self._d is not None:
+            return self._d.get(key, self.MISSING)
+        out = np.empty(1, np.int32)
+        self._lib.gp_map_get_batch(
+            self._h, _p(np.asarray([key], np.uint64), ctypes.c_uint64), 1,
+            _p(out, ctypes.c_int32), self.MISSING)
+        return int(out[0])
+
+    def get_batch(self, keys: np.ndarray) -> np.ndarray:
+        """i32 rows; MISSING (-1) where absent."""
+        if self._d is not None:
+            return np.asarray(
+                [self._d.get(int(k), self.MISSING) for k in keys],
+                np.int32)
+        keys = np.ascontiguousarray(keys, np.uint64)
+        out = np.empty(len(keys), np.int32)
+        self._lib.gp_map_get_batch(
+            self._h, _p(keys, ctypes.c_uint64), len(keys),
+            _p(out, ctypes.c_int32), self.MISSING)
+        return out
+
+    def delete(self, key: int) -> bool:
+        if self._d is not None:
+            return self._d.pop(key, None) is not None
+        return bool(self._lib.gp_map_del(self._h, key))
+
+    def __len__(self) -> int:
+        if self._d is not None:
+            return len(self._d)
+        return int(self._lib.gp_map_size(self._h))
+
+    def __del__(self):
+        if self._h is not None and self._lib is not None:
+            self._lib.gp_map_free(self._h)
+            self._h = None
+
+
+def have_native() -> bool:
+    return _load() is not None

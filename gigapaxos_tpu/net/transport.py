@@ -31,6 +31,7 @@ import struct
 from collections import deque
 from typing import Awaitable, Callable, Dict, Optional, Tuple
 
+from gigapaxos_tpu import native
 from gigapaxos_tpu.utils.logutil import get_logger
 from gigapaxos_tpu.utils.profiler import DelayProfiler
 
@@ -147,17 +148,29 @@ class Transport:
     def send(self, dst: int, frame: bytes) -> bool:
         """Queue a frame to node ``dst``.  Returns False on congestion drop
         or unknown destination.  Must be called on the loop."""
+        return self._enqueue(dst, frame, preframed=False, nframes=1)
+
+    def send_raw(self, dst: int, buf: bytes, nframes: int) -> bool:
+        """Queue a PRE-FRAMED buffer (frames already length-prefixed, e.g.
+        from ``native.encode_responses``): one writer call for a whole
+        response batch."""
+        return self._enqueue(dst, buf, preframed=True, nframes=nframes)
+
+    def _enqueue(self, dst: int, payload: bytes, preframed: bool,
+                 nframes: int) -> bool:
         if dst in self.addr_map:
             peer = self._peers.get(dst)
             if peer is None:
                 peer = self._peers[dst] = _Peer()
                 peer.task = self._loop.create_task(self._writer_loop(dst))
-            if peer.bytes_queued + len(frame) > self.max_queue_bytes:
-                self.dropped_frames += 1
+            if peer.bytes_queued + len(payload) > self.max_queue_bytes:
+                # a pre-framed batch drops as a unit (paxos tolerates
+                # loss; clients retransmit) — account every frame in it
+                self.dropped_frames += nframes
                 DelayProfiler.update_rate("net.drop")
                 return False
-            peer.queue.append(frame)
-            peer.bytes_queued += len(frame)
+            peer.queue.append((payload, preframed, nframes))
+            peer.bytes_queued += len(payload)
             peer.wake.set()
             return True
         # reply path over an inbound connection (client or unknown peer)
@@ -167,22 +180,32 @@ class Transport:
             return False
         # backpressure: a stalled client must not grow server memory —
         # consult the transport's write buffer against the same byte budget
-        if w.transport.get_write_buffer_size() + len(frame) > \
+        if w.transport.get_write_buffer_size() + len(payload) > \
                 self.max_queue_bytes:
-            self.dropped_frames += 1
+            self.dropped_frames += nframes
             DelayProfiler.update_rate("net.drop")
             return False
-        self._write_frame(w, frame)
+        self._write(w, payload, preframed, nframes)
         return True
 
     def send_threadsafe(self, dst: int, frame: bytes) -> None:
         self._loop.call_soon_threadsafe(self.send, dst, frame)
 
-    def _write_frame(self, w: asyncio.StreamWriter, frame: bytes) -> None:
-        w.write(_LEN.pack(len(frame)))
-        w.write(frame)
-        self.sent_frames += 1
-        self.sent_bytes += len(frame) + 4
+    def send_raw_threadsafe(self, dst: int, buf: bytes,
+                            nframes: int) -> None:
+        self._loop.call_soon_threadsafe(self.send_raw, dst, buf, nframes)
+
+    def _write(self, w: asyncio.StreamWriter, payload: bytes,
+               preframed: bool, nframes: int) -> None:
+        if preframed:
+            w.write(payload)
+            self.sent_frames += nframes
+            self.sent_bytes += len(payload)
+        else:
+            w.write(_LEN.pack(len(payload)))
+            w.write(payload)
+            self.sent_frames += 1
+            self.sent_bytes += len(payload) + 4
 
     # -- per-destination writer task --------------------------------------
 
@@ -210,9 +233,9 @@ class Transport:
             try:
                 while not self._closed:
                     while peer.queue:
-                        frame = peer.queue.popleft()
-                        peer.bytes_queued -= len(frame)
-                        self._write_frame(writer, frame)
+                        payload, preframed, nframes = peer.queue.popleft()
+                        peer.bytes_queued -= len(payload)
+                        self._write(writer, payload, preframed, nframes)
                     await writer.drain()
                     if not peer.queue:
                         peer.wake.clear()
@@ -229,18 +252,30 @@ class Transport:
     async def _read_frames(self, reader: asyncio.StreamReader) -> None:
         """Frame-read loop for the *outbound* side of a connection."""
         try:
-            while True:
-                hdr = await reader.readexactly(4)
-                (ln,) = _LEN.unpack(hdr)
-                if ln > MAX_FRAME:
-                    return
-                frame = await reader.readexactly(ln)
+            await self._scan_loop(reader)
+        except (asyncio.IncompleteReadError, ConnectionError, OSError,
+                asyncio.CancelledError, ValueError):
+            pass
+
+    async def _scan_loop(self, reader: asyncio.StreamReader) -> None:
+        """Chunked read + native frame scan (ref: MessageExtractor): one
+        ``read()`` and one C scan per chunk instead of two ``readexactly``
+        awaits per frame.  Raises ValueError on an oversized frame
+        (protocol violation -> drop the connection)."""
+        buf = bytearray()
+        while True:
+            chunk = await reader.read(1 << 18)
+            if not chunk:
+                return
+            buf += chunk
+            offs, lens, consumed = native.scan_frames(buf)
+            for o, ln in zip(offs, lens):
+                o, ln = int(o), int(ln)
                 self.rcvd_frames += 1
                 self.rcvd_bytes += ln + 4
-                self._dispatch(frame)
-        except (asyncio.IncompleteReadError, ConnectionError, OSError,
-                asyncio.CancelledError):
-            pass
+                self._dispatch(bytes(buf[o:o + ln]))
+            if consumed:
+                del buf[:consumed]
 
     def _dispatch(self, frame: bytes) -> None:
         """on_frame with a crash guard: one malformed/unknown frame must
@@ -267,16 +302,9 @@ class Transport:
                 return
             (peer_id,) = struct.unpack("<i", await reader.readexactly(4))
             self._inbound[peer_id] = writer
-            while True:
-                hdr = await reader.readexactly(4)
-                (ln,) = _LEN.unpack(hdr)
-                if ln > MAX_FRAME:
-                    log.error("oversized frame %d from %s", ln, peer_id)
-                    return
-                frame = await reader.readexactly(ln)
-                self.rcvd_frames += 1
-                self.rcvd_bytes += ln + 4
-                self._dispatch(frame)
+            await self._scan_loop(reader)
+        except ValueError:
+            log.error("oversized frame from %s", peer_id)
         except (asyncio.IncompleteReadError, ConnectionError, OSError,
                 asyncio.CancelledError):
             pass

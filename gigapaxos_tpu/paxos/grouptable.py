@@ -15,6 +15,9 @@ from __future__ import annotations
 from dataclasses import dataclass
 from typing import Dict, Iterator, Optional, Tuple
 
+import numpy as np
+
+from gigapaxos_tpu.native import KeyRowMap
 from gigapaxos_tpu.paxos.packets import group_key
 
 
@@ -35,6 +38,9 @@ class GroupTable:
         self.capacity = capacity
         self._by_key: Dict[int, GroupMeta] = {}
         self._by_row: Dict[int, GroupMeta] = {}
+        # native u64->i32 row index (C++ open addressing when available):
+        # rows_for_keys answers a whole packet batch in one call
+        self._rows = KeyRowMap(min(capacity, 1 << 16))
         # LIFO free list: recently freed rows are reused first, keeping the
         # hot row set dense/cache-friendly
         self._free = list(range(capacity - 1, -1, -1))
@@ -58,6 +64,7 @@ class GroupTable:
         meta = GroupMeta(name, gkey, row, tuple(members), version)
         self._by_key[gkey] = meta
         self._by_row[row] = meta
+        self._rows.put(gkey, row)
         return meta
 
     def delete(self, gkey: int) -> Optional[GroupMeta]:
@@ -66,7 +73,14 @@ class GroupTable:
             return None
         del self._by_row[meta.row]
         self._free.append(meta.row)
+        self._rows.delete(gkey)
         return meta
+
+    def rows_for_keys(self, gkeys: np.ndarray) -> np.ndarray:
+        """Batched gkey -> row lookup; -1 where unknown.  One native call
+        for a whole packet batch (the hot-path replacement for a Python
+        dict hit per item)."""
+        return self._rows.get_batch(gkeys)
 
     def by_key(self, gkey: int) -> Optional[GroupMeta]:
         return self._by_key.get(gkey)

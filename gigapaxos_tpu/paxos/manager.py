@@ -34,6 +34,7 @@ from typing import Dict, List, Optional, Set, Tuple
 
 import numpy as np
 
+from gigapaxos_tpu import native
 from gigapaxos_tpu.net.transport import Transport
 from gigapaxos_tpu.ops.types import (NO_BALLOT, NO_SLOT, pack_ballot,
                                      unpack_ballot)
@@ -133,6 +134,8 @@ class PaxosNode:
         self._tick_hooks: List = []
 
         self._inq: "queue_mod.Queue" = queue_mod.Queue()
+        # batched client-response buffer, live only inside _process
+        self._resp_out: Optional[Dict] = None
         self._stopping = False
         self.transport = Transport(
             node_id, addr_map[node_id], addr_map, self._on_frame)
@@ -245,10 +248,54 @@ class PaxosNode:
     # ------------------------------------------------------------------
 
     def _on_frame(self, frame: bytes) -> None:
-        """Event-loop side: decode + hand off to the worker (the demux
-        thread-pool analog collapses to one hand-off queue)."""
-        obj = pkt.decode(frame)
-        self._inq.put(obj)
+        """Event-loop side: hand the RAW frame to the worker — decode
+        happens off the event loop (the demux thread-pool analog collapses
+        to one hand-off queue), and REQUEST frames decode natively in
+        batch there."""
+        self._inq.put(frame)
+
+    def _decode_batch(self, batch: List) -> List:
+        """Worker-side decode: raw frames -> packet objects.  REQUEST
+        frames (the per-client-item hot type) go through the native SoA
+        parser in one C call; everything else decodes per frame."""
+        out = []
+        req_frames: List[bytes] = []
+        for item in batch:
+            if not isinstance(item, (bytes, bytearray, memoryview)):
+                out.append(item)  # self-routed object
+            elif len(item) == 0:
+                log.warning("dropping empty frame")
+            elif item[0] == int(pkt.PacketType.REQUEST):
+                req_frames.append(item)
+            else:
+                try:
+                    out.append(pkt.decode(item))
+                except Exception:
+                    log.exception("dropping malformed frame type %d",
+                                  item[0])
+        if req_frames:
+            try:
+                buf = b"".join(req_frames)
+                offs = np.cumsum(
+                    [0] + [len(f) for f in req_frames[:-1]],
+                    dtype=np.int64)
+                lens = np.asarray([len(f) for f in req_frames], np.int64)
+                sender, gkey, req_id, flags, pay_off, pay = \
+                    native.parse_requests(buf, offs, lens)
+                out.extend(
+                    pkt.Request(int(sender[i]), int(gkey[i]),
+                                int(req_id[i]), int(flags[i]),
+                                pay[pay_off[i]:pay_off[i + 1]])
+                    for i in range(len(req_frames)))
+            except ValueError:
+                # a malformed frame poisons the batch parse: fall back to
+                # per-frame decode, dropping only the bad ones
+                for f in req_frames:
+                    try:
+                        out.append(pkt.decode(f))
+                    except Exception:
+                        log.exception("dropping malformed request frame")
+        return out
 
     def _store_payload(self, req: int, flags: int, payload: bytes) -> None:
         """Keep the best copy: a real payload always beats a FLAG_MISSING
@@ -264,8 +311,28 @@ class PaxosNode:
         if dst == self.id:
             self._inq.put(obj)
         elif self._loop is not None:
+            if self._resp_out is not None and \
+                    type(obj) is pkt.Response:
+                # batch client responses for the end of this worker batch:
+                # ONE native encode + ONE writer call per destination
+                self._resp_out.setdefault(dst, []).append(
+                    (obj.gkey, obj.req_id, obj.status, obj.payload))
+                return
             self.transport.send_threadsafe(dst, obj.encode())
         # else: recovery runs before sockets exist; peers re-sync later
+
+    def _flush_responses(self) -> None:
+        out, self._resp_out = self._resp_out, None
+        if not out:
+            return
+        for dst, items in out.items():
+            buf = native.encode_responses(
+                self.id,
+                np.asarray([it[0] for it in items], np.uint64),
+                np.asarray([it[1] for it in items], np.uint64),
+                np.asarray([it[2] for it in items], np.uint8),
+                [it[3] for it in items])
+            self.transport.send_raw_threadsafe(dst, buf, len(items))
 
     # ------------------------------------------------------------------
     # worker
@@ -292,7 +359,7 @@ class PaxosNode:
                 batch.append(nxt)
             t0 = time.monotonic()
             try:
-                self._process(batch)
+                self._process(self._decode_batch(batch))
             except Exception:
                 log.exception("worker batch failed (%d items)", len(batch))
             DelayProfiler.update_delay("node.batch", t0, len(batch))
@@ -337,6 +404,13 @@ class PaxosNode:
     # -- batch processing ----------------------------------------------
 
     def _process(self, batch: List) -> None:
+        self._resp_out: Optional[Dict] = {}
+        try:
+            self._process_inner(batch)
+        finally:
+            self._flush_responses()
+
+    def _process_inner(self, batch: List) -> None:
         by_type: Dict[type, List] = {}
         for obj in batch:
             by_type.setdefault(type(obj), []).append(obj)
@@ -526,78 +600,90 @@ class PaxosNode:
     # -- accepts (acceptor side) ---------------------------------------
 
     def _handle_accepts(self, objs: List) -> None:
-        # flatten + coalesce: one lane per (row, slot), max ballot wins
-        best: Dict[Tuple[int, int], Tuple[int, int, int, bytes, int]] = {}
+        # flatten + coalesce: one lane per (row, slot), max ballot wins.
+        # gkey->row is ONE native batched lookup; the (row, slot) max-bal
+        # winner mask is ONE native hash pass (ref: PaxosPacketBatcher).
+        gkeys = np.concatenate([np.asarray(o.gkey, np.uint64)
+                                for o in objs])
+        slots_all = np.concatenate([np.asarray(o.slot, np.int32)
+                                    for o in objs])
+        bals_all = np.concatenate([np.asarray(o.bal, np.int32)
+                                   for o in objs])
+        rows_all = self.table.rows_for_keys(gkeys)
+        keep = native.coalesce_max(rows_all, slots_all, bals_all)
+        if not keep.any():
+            return
+        # per-lane metadata for the kept lanes
+        lane_src: List[Tuple[int, int, bytes]] = []  # (sender, req, blob)
         for o in objs:
             pls = o.payloads or [b""] * len(o.gkey)
             for j in range(len(o.gkey)):
-                meta = self.table.by_key(int(o.gkey[j]))
-                if meta is None:
-                    continue
-                key = (meta.row, int(o.slot[j]))
-                bal = int(o.bal[j])
-                if key not in best or bal > best[key][0]:
-                    req = _join_req(int(o.req_lo[j]), int(o.req_hi[j]))
-                    blob = pls[j]
-                    flags, payload = (blob[0], bytes(blob[1:])) if blob \
-                        else (0, b"")
-                    best[key] = (bal, req, flags, payload, o.sender)
-        if not best:
-            return
-        keys = list(best.keys())
-        rows = np.asarray([k[0] for k in keys], np.int32)
-        slots = np.asarray([k[1] for k in keys], np.int32)
-        bals = np.asarray([best[k][0] for k in keys], np.int32)
-        req_ids = np.asarray([best[k][1] for k in keys], np.uint64)
+                lane_src.append((o.sender,
+                                 _join_req(int(o.req_lo[j]),
+                                           int(o.req_hi[j])), pls[j]))
+        idxs = np.flatnonzero(keep)
+        rows = rows_all[idxs]
+        slots = slots_all[idxs]
+        bals = bals_all[idxs]
+        req_ids = np.asarray([lane_src[i][1] for i in idxs], np.uint64)
         res = self.backend.accept(rows, slots, bals, req_ids)
 
         entries = []
-        for i, k in enumerate(keys):
-            bal, req, flags, payload, sender = best[k]
-            if res.acked[i]:
-                self._store_payload(req, flags, payload)
-                self._bal_seen[k[0]] = max(self._bal_seen.get(k[0],
-                                                             NO_BALLOT), bal)
-                entries.append(LogEntry(REC_ACCEPT, self.table.by_row(
-                    k[0]).gkey, k[1], bal, req, bytes([flags]) + payload))
+        for i, li in enumerate(idxs):
+            if not res.acked[i]:
+                continue
+            sender, req, blob = lane_src[li]
+            flags, payload = (blob[0], bytes(blob[1:])) if blob \
+                else (0, b"")
+            row, bal = int(rows[i]), int(bals[i])
+            self._store_payload(req, flags, payload)
+            self._bal_seen[row] = max(self._bal_seen.get(row, NO_BALLOT),
+                                      bal)
+            entries.append(LogEntry(REC_ACCEPT, int(gkeys[li]),
+                                    int(slots[i]), bal, req,
+                                    bytes([flags]) + payload))
         # durability barrier: fsync BEFORE replies leave (SURVEY §7.3.2)
         if entries:
             self.logger.log_batch(entries).result()
 
         # group replies per coordinator sender
         by_coord: Dict[int, List[int]] = {}
-        for i, k in enumerate(keys):
+        for i, li in enumerate(idxs):
             if res.out_window[i]:
                 continue  # dropped; coordinator retries / window advances
-            by_coord.setdefault(best[k][4], []).append(i)
-        for dst, idxs in by_coord.items():
+            by_coord.setdefault(lane_src[li][0], []).append(i)
+        for dst, iidx in by_coord.items():
             arb = pkt.AcceptReplyBatch(
                 self.id,
-                np.asarray([self.table.by_row(keys[i][0]).gkey
-                            for i in idxs], np.uint64),
-                np.asarray([keys[i][1] for i in idxs], np.int32),
-                np.asarray([int(best[keys[i]][0]) if res.acked[i]
-                            else int(res.cur_bal[i]) for i in idxs],
+                np.asarray([gkeys[idxs[i]] for i in iidx], np.uint64),
+                np.asarray([slots[i] for i in iidx], np.int32),
+                np.asarray([int(bals[i]) if res.acked[i]
+                            else int(res.cur_bal[i]) for i in iidx],
                            np.int32),
-                np.asarray([1 if res.acked[i] else 0 for i in idxs],
+                np.asarray([1 if res.acked[i] else 0 for i in iidx],
                            np.uint8))
             self._route(dst, arb)
 
     # -- accept replies (coordinator side) ------------------------------
 
     def _handle_accept_replies(self, objs: List) -> None:
+        all_rows = self.table.rows_for_keys(
+            np.concatenate([np.asarray(o.gkey, np.uint64) for o in objs]))
         seen: Set[Tuple[int, int, int]] = set()
         rows_l, slots_l, bals_l, senders_l, acked_l = [], [], [], [], []
+        pos = 0
         for o in objs:
             for j in range(len(o.gkey)):
-                meta = self.table.by_key(int(o.gkey[j]))
-                if meta is None:
+                row = int(all_rows[pos])
+                pos += 1
+                if row < 0:
                     continue
-                key = (meta.row, int(o.slot[j]), o.sender)
+                key = (row, int(o.slot[j]), o.sender)
                 if key in seen:
                     continue
                 seen.add(key)
-                rows_l.append(meta.row)
+                meta = self.table.by_row(row)
+                rows_l.append(row)
                 slots_l.append(int(o.slot[j]))
                 bals_l.append(int(o.bal[j]))
                 senders_l.append(meta.members.index(o.sender)
@@ -638,16 +724,20 @@ class PaxosNode:
     # -- commits → execution -------------------------------------------
 
     def _handle_commits(self, objs: List) -> None:
+        all_rows = self.table.rows_for_keys(
+            np.concatenate([np.asarray(o.gkey, np.uint64) for o in objs]))
         ded: Dict[Tuple[int, int], int] = {}
+        pos = 0
         for o in objs:
             for j in range(len(o.gkey)):
-                meta = self.table.by_key(int(o.gkey[j]))
-                if meta is None:
+                row = int(all_rows[pos])
+                pos += 1
+                if row < 0:
                     continue
                 req = _join_req(int(o.req_lo[j]), int(o.req_hi[j]))
-                ded[(meta.row, int(o.slot[j]))] = req
-                self._bal_seen[meta.row] = max(
-                    self._bal_seen.get(meta.row, NO_BALLOT), int(o.bal[j]))
+                ded[(row, int(o.slot[j]))] = req
+                self._bal_seen[row] = max(
+                    self._bal_seen.get(row, NO_BALLOT), int(o.bal[j]))
         if not ded:
             return
         keys = list(ded.keys())

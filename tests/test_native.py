@@ -1,0 +1,156 @@
+"""Native C++ hot-path codec: parity with the Python fallbacks, fuzz,
+and the KeyRowMap (ref analogs: nio/MessageExtractor, paxospackets
+byteification, utils/MultiArrayMap — see gigapaxos_tpu/native/hotpath.cc).
+"""
+
+import struct
+
+import numpy as np
+import pytest
+
+from gigapaxos_tpu import native
+from gigapaxos_tpu.paxos import packets as pkt
+
+
+pytestmark = pytest.mark.skipif(not native.have_native(),
+                                reason="g++ unavailable")
+
+
+def _fallback(monkeypatch):
+    """Force the pure-Python implementations."""
+    monkeypatch.setattr(native, "_load", lambda: None)
+
+
+def _request_stream(n, seed=0, torn_tail=b""):
+    rng = np.random.default_rng(seed)
+    reqs, frames = [], []
+    for i in range(n):
+        r = pkt.Request(int(rng.integers(1, 1 << 31)),
+                        int(rng.integers(1, 1 << 63, dtype=np.int64)),
+                        (7 << 32) | i, int(rng.integers(0, 4)),
+                        bytes(rng.integers(0, 256,
+                                           int(rng.integers(0, 64)),
+                                           dtype=np.uint8)))
+        reqs.append(r)
+        f = r.encode()
+        frames.append(struct.pack("<I", len(f)) + f)
+    return reqs, b"".join(frames) + torn_tail
+
+
+def test_scan_parse_roundtrip_and_fallback_parity(monkeypatch):
+    reqs, stream = _request_stream(500, torn_tail=b"\x09\x00\x00\x00ab")
+    offs, lens, consumed = native.scan_frames(stream)
+    assert len(offs) == 500
+    assert consumed == len(stream) - 6  # torn frame not consumed
+    got = native.parse_requests(stream, offs, lens)
+    _fallback(monkeypatch)
+    offs2, lens2, consumed2 = native.scan_frames(stream)
+    assert np.array_equal(offs2, offs) and consumed2 == consumed
+    got2 = native.parse_requests(stream, offs2, lens2)
+    for a, b in zip(got, got2):
+        if isinstance(a, np.ndarray):
+            assert np.array_equal(a, b)
+        else:
+            assert a == b
+    sender, gkey, req_id, flags, pay_off, pay = got
+    for i, r in enumerate(reqs):
+        assert (int(sender[i]), int(gkey[i]), int(req_id[i]),
+                int(flags[i])) == (r.sender, r.gkey, r.req_id, r.flags)
+        assert pay[pay_off[i]:pay_off[i + 1]] == r.payload
+
+
+def test_scan_oversized_frame_rejected():
+    bad = struct.pack("<I", native.MAX_FRAME + 1) + b"x" * 16
+    with pytest.raises(ValueError):
+        native.scan_frames(bad)
+
+
+def test_encode_responses_decodable_and_parity(monkeypatch):
+    n = 300
+    rng = np.random.default_rng(1)
+    gk = rng.integers(1, 1 << 63, n, dtype=np.int64).astype(np.uint64)
+    ri = rng.integers(1, 1 << 62, n, dtype=np.int64).astype(np.uint64)
+    st = rng.integers(0, 4, n).astype(np.uint8)
+    pls = [bytes(rng.integers(0, 256, int(rng.integers(0, 32)),
+                              dtype=np.uint8)) for _ in range(n)]
+    buf = native.encode_responses(9, gk, ri, st, pls)
+    _fallback(monkeypatch)
+    assert native.encode_responses(9, gk, ri, st, pls) == buf
+    offs, lens, consumed = native.scan_frames(buf)
+    assert len(offs) == n and consumed == len(buf)
+    for i in (0, n // 2, n - 1):
+        o, ln = int(offs[i]), int(lens[i])
+        r = pkt.decode(memoryview(buf)[o:o + ln])
+        assert isinstance(r, pkt.Response)
+        assert (r.gkey, r.req_id, r.status, r.payload) == \
+            (int(gk[i]), int(ri[i]), int(st[i]), pls[i])
+
+
+def test_coalesce_max_parity_fuzz(monkeypatch):
+    rng = np.random.default_rng(2)
+    for trial in range(5):
+        n = int(rng.integers(1, 4000))
+        row = rng.integers(-1, 30, n).astype(np.int32)
+        slot = rng.integers(0, 6, n).astype(np.int32)
+        bal = rng.integers(0, 50, n).astype(np.int32)
+        kn = native.coalesce_max(row, slot, bal)
+        with pytest.MonkeyPatch.context() as mp:
+            mp.setattr(native, "_load", lambda: None)
+            kp = native.coalesce_max(row, slot, bal)
+        assert np.array_equal(kn, kp)
+        # exactly one winner per live (row, slot); winner has max ballot
+        live = row >= 0
+        for r, s in {(int(r), int(s))
+                     for r, s in zip(row[live], slot[live])}:
+            m = (row == r) & (slot == s)
+            assert kn[m].sum() == 1
+            assert bal[m][kn[m]][0] == bal[m].max()
+
+
+def test_key_row_map_put_get_delete_grow():
+    m = native.KeyRowMap(4)  # tiny hint: forces growth
+    rng = np.random.default_rng(3)
+    keys = np.unique(rng.integers(1, 1 << 63, 5000,
+                                  dtype=np.int64).astype(np.uint64))
+    for i, k in enumerate(keys):
+        m.put(int(k), i)
+    assert len(m) == len(keys)
+    assert np.array_equal(m.get_batch(keys),
+                          np.arange(len(keys), dtype=np.int32))
+    assert m.get(int(keys[7])) == 7
+    assert m.get(123456789) == native.KeyRowMap.MISSING
+    # delete a third, check the rest survive backward-shift compaction
+    for i in range(0, len(keys), 3):
+        assert m.delete(int(keys[i]))
+    assert not m.delete(int(keys[0]))  # already gone
+    got = m.get_batch(keys)
+    for i in range(len(keys)):
+        assert got[i] == (native.KeyRowMap.MISSING if i % 3 == 0 else i)
+    # reuse freed keys (create/delete churn pattern)
+    for i in range(0, len(keys), 3):
+        m.put(int(keys[i]), -i - 2 & 0x7FFFFFFF)
+    assert len(m) == len(keys)
+
+
+def test_manager_batch_decode_mixed_frames():
+    """_decode_batch: raw REQUEST frames batch-parse natively; other raw
+    frames decode per-frame; already-decoded objects pass through."""
+    from gigapaxos_tpu.paxos.manager import PaxosNode
+
+    reqs, stream = _request_stream(20)
+    offs, lens, _ = native.scan_frames(stream)
+    raw_reqs = [stream[int(o):int(o) + int(ln)]
+                for o, ln in zip(offs, lens)]
+    ping = pkt.FailureDetect(3, 0, 42)
+    batch = raw_reqs[:10] + [ping.encode(), ping] + raw_reqs[10:]
+    out = PaxosNode._decode_batch.__wrapped__(None, batch) \
+        if hasattr(PaxosNode._decode_batch, "__wrapped__") \
+        else PaxosNode._decode_batch(object.__new__(PaxosNode), batch)
+    reqs_out = [o for o in out if isinstance(o, pkt.Request)]
+    assert len(reqs_out) == 20
+    by_id = {r.req_id: r for r in reqs_out}
+    for r in reqs:
+        got = by_id[r.req_id]
+        assert (got.sender, got.gkey, got.flags, got.payload) == \
+            (r.sender, r.gkey, r.flags, r.payload)
+    assert sum(isinstance(o, pkt.FailureDetect) for o in out) == 2
