@@ -1,0 +1,8 @@
+#!/usr/bin/env bash
+# ref: upstream bin/gpClient.sh — console client.
+#   bin/gpclient.sh [properties-file] <cmd> [args...]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+CONF="conf/gigapaxos.properties"
+if [[ "${1:-}" == *.properties ]]; then CONF="$1"; shift; fi
+exec python -m gigapaxos_tpu.client_cli --config "$CONF" "$@"

@@ -1,0 +1,10 @@
+#!/usr/bin/env bash
+# ref: upstream bin/gpServer.sh — boot one node of the cluster.
+#   bin/gpserver.sh <node-id> [properties-file] [logdir]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+ID="${1:?usage: gpserver.sh <node-id> [properties] [logdir]}"
+CONF="${2:-conf/gigapaxos.properties}"
+LOGDIR="${3:-/tmp/gigapaxos_tpu}"
+exec python -m gigapaxos_tpu.server --config "$CONF" --id "$ID" \
+    --logdir "$LOGDIR"

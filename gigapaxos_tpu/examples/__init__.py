@@ -1,0 +1,17 @@
+"""Example applications (ref: ``gigapaxos/examples/`` + the upstream
+chat/calculator tutorials).
+
+Each example implements the :class:`~gigapaxos_tpu.paxos.interfaces.
+Replicable` boundary — ``execute``/``checkpoint``/``restore`` — and is
+runnable against a real cluster via::
+
+    python -m gigapaxos_tpu.server --config conf/gigapaxos.properties \
+        --id 0 --app gigapaxos_tpu.examples.chatapp:ChatApp
+
+Built-in minimal apps (``NoopApp``, ``CounterApp``, ``KVApp``) live in
+``gigapaxos_tpu.paxos.interfaces``.
+"""
+
+from gigapaxos_tpu.examples.chatapp import ChatApp
+
+__all__ = ["ChatApp"]

@@ -72,7 +72,20 @@ def _load() -> Optional[ctypes.CDLL]:
         so = _build()
         if so is None:
             return None
-        lib = ctypes.CDLL(so)
+        try:
+            lib = ctypes.CDLL(so)
+        except OSError as e:
+            # stale/foreign cached .so (different arch or libstdc++):
+            # rebuild once from source, else fall back to Python
+            log.warning("cached %s unloadable (%s); rebuilding", so, e)
+            try:
+                os.remove(so)
+                so = _build()
+                lib = ctypes.CDLL(so) if so else None
+            except OSError:
+                lib = None
+            if lib is None:
+                return None
         i64, u64p = ctypes.c_int64, ctypes.POINTER(ctypes.c_uint64)
         i64p = ctypes.POINTER(ctypes.c_int64)
         u8p = ctypes.POINTER(ctypes.c_uint8)
@@ -296,6 +309,13 @@ class KeyRowMap:
 
     Native open-addressing map when available, else a dict.  ``get_batch``
     is the hot call: one C call for a whole packet batch.
+
+    Thread safety: the native map is NOT internally synchronized, and
+    ctypes releases the GIL during calls — a ``put`` that grows the table
+    frees the arrays a concurrent ``get_batch`` could be scanning.  All
+    native calls therefore take a Python-level lock (mutations come from
+    the worker thread and the public create/delete API; contention is
+    negligible next to the batch work).
     """
 
     MISSING = -1
@@ -304,6 +324,7 @@ class KeyRowMap:
         self._lib = _load()
         self._h = None
         self._d: Optional[dict] = None
+        self._lock = threading.Lock()
         if self._lib is not None:
             self._h = self._lib.gp_map_new(cap_hint)
         if self._h is None:
@@ -312,16 +333,20 @@ class KeyRowMap:
     def put(self, key: int, row: int) -> None:
         if self._d is not None:
             self._d[key] = row
-        elif self._lib.gp_map_put(self._h, key, row) != 0:
-            raise MemoryError("gp_map_put")
+            return
+        with self._lock:
+            if self._lib.gp_map_put(self._h, key, row) != 0:
+                raise MemoryError("gp_map_put")
 
     def get(self, key: int) -> int:
         if self._d is not None:
             return self._d.get(key, self.MISSING)
         out = np.empty(1, np.int32)
-        self._lib.gp_map_get_batch(
-            self._h, _p(np.asarray([key], np.uint64), ctypes.c_uint64), 1,
-            _p(out, ctypes.c_int32), self.MISSING)
+        with self._lock:
+            self._lib.gp_map_get_batch(
+                self._h, _p(np.asarray([key], np.uint64),
+                            ctypes.c_uint64), 1,
+                _p(out, ctypes.c_int32), self.MISSING)
         return int(out[0])
 
     def get_batch(self, keys: np.ndarray) -> np.ndarray:
@@ -332,20 +357,23 @@ class KeyRowMap:
                 np.int32)
         keys = np.ascontiguousarray(keys, np.uint64)
         out = np.empty(len(keys), np.int32)
-        self._lib.gp_map_get_batch(
-            self._h, _p(keys, ctypes.c_uint64), len(keys),
-            _p(out, ctypes.c_int32), self.MISSING)
+        with self._lock:
+            self._lib.gp_map_get_batch(
+                self._h, _p(keys, ctypes.c_uint64), len(keys),
+                _p(out, ctypes.c_int32), self.MISSING)
         return out
 
     def delete(self, key: int) -> bool:
         if self._d is not None:
             return self._d.pop(key, None) is not None
-        return bool(self._lib.gp_map_del(self._h, key))
+        with self._lock:
+            return bool(self._lib.gp_map_del(self._h, key))
 
     def __len__(self) -> int:
         if self._d is not None:
             return len(self._d)
-        return int(self._lib.gp_map_size(self._h))
+        with self._lock:
+            return int(self._lib.gp_map_size(self._h))
 
     def __del__(self):
         if self._h is not None and self._lib is not None:

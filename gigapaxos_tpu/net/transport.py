@@ -38,7 +38,7 @@ from gigapaxos_tpu.utils.profiler import DelayProfiler
 log = get_logger("gp.net")
 
 _LEN = struct.Struct("<I")
-MAX_FRAME = 64 * 1024 * 1024
+MAX_FRAME = native.MAX_FRAME  # one limit for scan + send paths
 
 
 class Demultiplexer:
@@ -105,6 +105,12 @@ class Transport:
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._closed = False
 
+        # fault injection for the test harness (ref: TESTPaxosConfig
+        # message-drop emulation): probability of dropping an outbound
+        # payload.  0.0 in production.
+        self.test_drop_rate = 0.0
+        self._drop_rng = None
+
         # NIOInstrumenter analog
         self.sent_frames = 0
         self.sent_bytes = 0
@@ -158,6 +164,13 @@ class Transport:
 
     def _enqueue(self, dst: int, payload: bytes, preframed: bool,
                  nframes: int) -> bool:
+        if self.test_drop_rate > 0.0:
+            if self._drop_rng is None:
+                import random
+                self._drop_rng = random.Random(self.id * 7919 + 13)
+            if self._drop_rng.random() < self.test_drop_rate:
+                self.dropped_frames += nframes
+                return False
         if dst in self.addr_map:
             peer = self._peers.get(dst)
             if peer is None:
@@ -273,7 +286,7 @@ class Transport:
                 o, ln = int(o), int(ln)
                 self.rcvd_frames += 1
                 self.rcvd_bytes += ln + 4
-                self._dispatch(bytes(buf[o:o + ln]))
+                self._dispatch(bytes(memoryview(buf)[o:o + ln]))
             if consumed:
                 del buf[:consumed]
 

@@ -515,6 +515,59 @@ def scatter_rows(state: ColumnarState, rows, row_state: ColumnarState,
 
 
 # --------------------------------------------------------------------------
+# packed wrappers: ONE [k, B] i32 input and ONE [k, B] i32 output per call.
+#
+# Motivation: each host<->device transfer costs a full link round trip
+# (tens of ms on a tunneled chip, tens of us on local PCIe); the unpacked
+# kernels take 5-7 separate batch arrays per call, which the runtime would
+# pay per argument.  The node runtime therefore drives these four hot
+# entry points with all lanes packed into a single array each way.
+# --------------------------------------------------------------------------
+
+
+def propose_packed(state: ColumnarState, packed):
+    """packed[4, B]: g, rlo, rhi, valid -> out[5, B]: granted, rejected,
+    throttled, slot, cbal."""
+    g, rlo, rhi = packed[0], packed[1], packed[2]
+    valid = packed[3] != 0
+    state, o = propose_batch(state, g, rlo, rhi, valid)
+    return state, jnp.stack([
+        o.granted.astype(i32), o.rejected.astype(i32),
+        o.throttled.astype(i32), o.slot, o.cbal])
+
+
+def accept_packed(state: ColumnarState, packed):
+    """packed[6, B]: g, slot, bal, rlo, rhi, valid -> out[4, B]: acked,
+    stale, out_window, cur_bal."""
+    state, o = accept_batch(state, packed[0], packed[1], packed[2],
+                            packed[3], packed[4], packed[5] != 0)
+    return state, jnp.stack([
+        o.acked.astype(i32), o.stale.astype(i32),
+        o.out_window.astype(i32), o.cur_bal])
+
+
+def accept_reply_packed(state: ColumnarState, packed):
+    """packed[6, B]: g, slot, bal, sender, acked, valid -> out[6, B]:
+    newly_decided, preempted, dec_bal, req_lo, req_hi, dec_slot."""
+    state, o = accept_reply_batch(state, packed[0], packed[1], packed[2],
+                                  packed[3], packed[4] != 0,
+                                  packed[5] != 0)
+    return state, jnp.stack([
+        o.newly_decided.astype(i32), o.preempted.astype(i32), o.dec_bal,
+        o.req_lo, o.req_hi, o.dec_slot])
+
+
+def commit_packed(state: ColumnarState, packed):
+    """packed[5, B]: g, slot, rlo, rhi, valid -> out[4, B]: applied,
+    stale, out_window, new_cursor."""
+    state, o = commit_batch(state, packed[0], packed[1], packed[2],
+                            packed[3], packed[4] != 0)
+    return state, jnp.stack([
+        o.applied.astype(i32), o.stale.astype(i32),
+        o.out_window.astype(i32), o.new_cursor])
+
+
+# --------------------------------------------------------------------------
 # jit entry points
 # --------------------------------------------------------------------------
 
@@ -525,6 +578,10 @@ accept = jax.jit(accept_batch, donate_argnums=0)
 accept_reply = jax.jit(accept_reply_batch, donate_argnums=0)
 propose = jax.jit(propose_batch, donate_argnums=0)
 commit = jax.jit(commit_batch, donate_argnums=0)
+propose_p = jax.jit(propose_packed, donate_argnums=0)
+accept_p = jax.jit(accept_packed, donate_argnums=0)
+accept_reply_p = jax.jit(accept_reply_packed, donate_argnums=0)
+commit_p = jax.jit(commit_packed, donate_argnums=0)
 prepare = jax.jit(prepare_batch, donate_argnums=0)
 install_coordinator = jax.jit(install_coordinator_batch, donate_argnums=0)
 create_groups = jax.jit(create_groups_batch, donate_argnums=0)

@@ -368,6 +368,20 @@ class ColumnarBackend(AcceptorBackend):
         """Device outputs -> host numpy, sliced back to live length."""
         return tuple(np.asarray(x)[:n] for x in out)
 
+    def _packed(self, n, *cols):
+        """Stack batch columns into ONE padded [k, bucket] i32 array with
+        the valid mask as the last row — a single host->device transfer
+        per kernel call (link round trips dominate small batches)."""
+        import jax.numpy as jnp
+        b = _bucket(n)
+        out = np.zeros((len(cols) + 1, b), np.int32)
+        for i, (col, fill) in enumerate(cols):
+            if fill:
+                out[i, n:] = fill
+            out[i, :n] = np.asarray(col).astype(np.int32, copy=False)
+        out[len(cols), :n] = 1  # valid mask
+        return jnp.asarray(out)
+
     # -- ops ---------------------------------------------------------------
 
     def create(self, rows, members, versions, init_bal, self_coord):
@@ -385,43 +399,43 @@ class ColumnarBackend(AcceptorBackend):
     def accept(self, rows, slots, bals, req_ids) -> AcceptRes:
         n = len(rows)
         lo, hi = _split64(req_ids)
-        self.state, o = self._k.accept(
-            self.state, self._pad1(rows, 0), self._pad1(slots, NO_SLOT),
-            self._pad1(bals, NO_BALLOT), self._pad1(lo, 0),
-            self._pad1(hi, 0), self._valid(n))
-        return AcceptRes(*self._np(o, n))
+        self.state, o = self._k.accept_p(self.state, self._packed(
+            n, (rows, 0), (slots, NO_SLOT), (bals, NO_BALLOT), (lo, 0),
+            (hi, 0)))
+        out = np.asarray(o)[:, :n]
+        return AcceptRes(out[0] != 0, out[1] != 0, out[2] != 0, out[3])
 
     def accept_reply(self, rows, slots, bals, senders, acked
                      ) -> AcceptReplyRes:
         n = len(rows)
-        self.state, o = self._k.accept_reply(
-            self.state, self._pad1(rows, 0), self._pad1(slots, NO_SLOT),
-            self._pad1(bals, NO_BALLOT), self._pad1(senders, 0),
-            self._pad1(acked, False, bool), self._valid(n))
-        newly, pre, _, dbal, rlo, rhi = self._np(o, n)
+        self.state, o = self._k.accept_reply_p(self.state, self._packed(
+            n, (rows, 0), (slots, NO_SLOT), (bals, NO_BALLOT),
+            (senders, 0), (np.asarray(acked, np.int32), 0)))
+        out = np.asarray(o)[:, :n]
+        newly = out[0] != 0
         # decision fields only meaningful on newly-decided lanes
-        rlo = np.where(newly, rlo, 0)
-        rhi = np.where(newly, rhi, 0)
-        dbal = np.where(newly, dbal, NO_BALLOT)
-        return AcceptReplyRes(newly, pre, rlo, rhi, dbal)
+        return AcceptReplyRes(
+            newly, out[1] != 0, np.where(newly, out[3], 0),
+            np.where(newly, out[4], 0),
+            np.where(newly, out[2], NO_BALLOT))
 
     def propose(self, rows, req_ids) -> ProposeRes:
         n = len(rows)
         lo, hi = _split64(req_ids)
-        self.state, o = self._k.propose(
-            self.state, self._pad1(rows, 0), self._pad1(lo, 0),
-            self._pad1(hi, 0), self._valid(n))
-        granted, rejected, throttled, slot, cbal = self._np(o, n)
-        slot = np.where(granted, slot, NO_SLOT)  # slot only valid if granted
-        return ProposeRes(granted, rejected, throttled, slot, cbal)
+        self.state, o = self._k.propose_p(self.state, self._packed(
+            n, (rows, 0), (lo, 0), (hi, 0)))
+        out = np.asarray(o)[:, :n]
+        granted = out[0] != 0
+        return ProposeRes(granted, out[1] != 0, out[2] != 0,
+                          np.where(granted, out[3], NO_SLOT), out[4])
 
     def commit(self, rows, slots, req_ids) -> CommitRes:
         n = len(rows)
         lo, hi = _split64(req_ids)
-        self.state, o = self._k.commit(
-            self.state, self._pad1(rows, 0), self._pad1(slots, NO_SLOT),
-            self._pad1(lo, 0), self._pad1(hi, 0), self._valid(n))
-        return CommitRes(*self._np(o, n))
+        self.state, o = self._k.commit_p(self.state, self._packed(
+            n, (rows, 0), (slots, NO_SLOT), (lo, 0), (hi, 0)))
+        out = np.asarray(o)[:, :n]
+        return CommitRes(out[0] != 0, out[1] != 0, out[2] != 0, out[3])
 
     def prepare(self, rows, bals) -> PrepareRes:
         n = len(rows)

@@ -42,6 +42,7 @@ class PaxosClientAsync:
         self._conns: Dict[int, Tuple[asyncio.StreamReader,
                                      asyncio.StreamWriter]] = {}
         self._read_tasks: Dict[int, asyncio.Task] = {}
+        self._conn_locks: Dict[int, asyncio.Lock] = {}
         self._waiting: Dict[int, asyncio.Future] = {}
         self._preferred = 0
 
@@ -52,14 +53,21 @@ class PaxosClientAsync:
         c = self._conns.get(idx)
         if c is not None and not c[1].is_closing():
             return c
-        host, port = self.servers[idx]
-        reader, writer = await asyncio.open_connection(host, port)
-        writer.write(_LEN.pack(4) + struct.pack("<i", self.id))
-        self._conns[idx] = (reader, writer)
-        t = asyncio.get_running_loop().create_task(self._read_loop(idx,
-                                                                   reader))
-        self._read_tasks[idx] = t
-        return reader, writer
+        # per-server lock: a concurrent first burst must not open one
+        # connection per request (socket/read-task leak)
+        lock = self._conn_locks.setdefault(idx, asyncio.Lock())
+        async with lock:
+            c = self._conns.get(idx)
+            if c is not None and not c[1].is_closing():
+                return c
+            host, port = self.servers[idx]
+            reader, writer = await asyncio.open_connection(host, port)
+            writer.write(_LEN.pack(4) + struct.pack("<i", self.id))
+            self._conns[idx] = (reader, writer)
+            t = asyncio.get_running_loop().create_task(
+                self._read_loop(idx, reader))
+            self._read_tasks[idx] = t
+            return reader, writer
 
     async def _read_loop(self, idx: int, reader: asyncio.StreamReader):
         try:
@@ -133,11 +141,14 @@ class PaxosClientAsync:
         return oks == len(server_ids)
 
     async def close(self):
-        for t in self._read_tasks.values():
+        tasks = list(self._read_tasks.values())
+        for t in tasks:
             t.cancel()
         for _, w in self._conns.values():
             w.close()
+        await asyncio.gather(*tasks, return_exceptions=True)
         self._conns.clear()
+        self._read_tasks.clear()
 
 
 class PaxosClient:

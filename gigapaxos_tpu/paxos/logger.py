@@ -220,11 +220,15 @@ class PaxosLogger:
     # -- checkpoints -------------------------------------------------------
 
     def checkpoint(self, rec: CheckpointRec) -> None:
+        self.checkpoint_many([rec])
+
+    def checkpoint_many(self, recs: List[CheckpointRec]) -> None:
         with self._db_lock:
-            self._db.execute(
+            self._db.executemany(
                 "INSERT OR REPLACE INTO checkpoints VALUES (?,?,?,?,?,?)",
-                (_signed(rec.gkey), rec.name, rec.version,
-                 json.dumps(list(rec.members)), rec.slot, rec.state))
+                [(_signed(r.gkey), r.name, r.version,
+                  json.dumps(list(r.members)), r.slot, r.state)
+                 for r in recs])
             self._db.commit()
 
     def get_checkpoint(self, gkey: int) -> Optional[CheckpointRec]:
@@ -257,20 +261,31 @@ class PaxosLogger:
 
     def put_group(self, gkey: int, name: str, version: int,
                   members: Tuple[int, ...]) -> None:
+        self.put_groups([(gkey, name, version, members)])
+
+    def put_groups(self, items: List[Tuple[int, str, int,
+                                           Tuple[int, ...]]]) -> None:
+        """Batched birth records: ONE transaction for n groups (ref: the
+        reconfiguration batched-creates knob; 10K-churn configs die on a
+        commit per create)."""
         with self._db_lock:
-            self._db.execute(
+            self._db.executemany(
                 "INSERT OR REPLACE INTO groups VALUES (?,?,?,?)",
-                (_signed(gkey), name, version, json.dumps(list(members))))
+                [(_signed(g), n, v, json.dumps(list(m)))
+                 for g, n, v, m in items])
             self._db.commit()
 
     def delete_group(self, gkey: int) -> None:
+        self.delete_groups([gkey])
+
+    def delete_groups(self, gkeys: List[int]) -> None:
+        """Batched delete of birth/checkpoint/pause records: ONE txn."""
         with self._db_lock:
-            self._db.execute("DELETE FROM groups WHERE gkey=?",
-                             (_signed(gkey),))
-            self._db.execute("DELETE FROM checkpoints WHERE gkey=?",
-                             (_signed(gkey),))
-            self._db.execute("DELETE FROM pause WHERE gkey=?",
-                             (_signed(gkey),))
+            keys = [(_signed(g),) for g in gkeys]
+            self._db.executemany("DELETE FROM groups WHERE gkey=?", keys)
+            self._db.executemany("DELETE FROM checkpoints WHERE gkey=?",
+                                 keys)
+            self._db.executemany("DELETE FROM pause WHERE gkey=?", keys)
             self._db.commit()
 
     def all_groups(self) -> List[Tuple[int, str, int, Tuple[int, ...]]]:

@@ -203,45 +203,81 @@ class PaxosNode:
         Initial coordinator is deterministic from the group key, and every
         replica starts promised to it at ballot (0, coord) — so it safely
         skips phase 1 (no prior accepts can exist)."""
-        if self.table.by_name(name) is not None:
-            return False
-        meta = self.table.create(name, members, version)
-        self._group_stopped.discard(meta.row)  # rows are recycled
-        coord = members[meta.gkey % len(members)]
-        init_bal = pack_ballot(0, coord)
+        return self.create_groups([(name, members)], version,
+                                  initial_state, durable) == 1
+
+    def create_groups(self, items: List[Tuple[str, Tuple[int, ...]]],
+                      version: int = 0, initial_state: bytes = b"",
+                      durable: bool = True) -> int:
+        """Batched create (ref: batched CreateServiceName): ONE device
+        scatter + ONE durable transaction for n groups — the 10K/s churn
+        path.  Returns how many were actually created (existing names
+        skipped)."""
+        metas = []
+        try:
+            for name, members in items:
+                if self.table.by_name(name) is not None:
+                    continue
+                meta = self.table.create(name, members, version)
+                self._group_stopped.discard(meta.row)  # recycled rows
+                metas.append(meta)
+        except (MemoryError, ValueError):
+            # capacity exhausted / key collision mid-batch: a group must
+            # never be visible in the table without device state and a
+            # durable birth record — roll the partial batch back
+            for meta in metas:
+                self.table.delete(meta.gkey)
+            raise
+        if not metas:
+            return 0
+        coords = [m.members[m.gkey % len(m.members)] for m in metas]
+        bals = [pack_ballot(0, c) for c in coords]
         self.backend.create(
-            np.asarray([meta.row], np.int32),
-            np.asarray([len(members)], np.int32),
-            np.asarray([version], np.int32),
-            np.asarray([init_bal], np.int32),
-            np.asarray([coord == self.id]))
-        self._bal_seen[meta.row] = init_bal
-        self._cursor[meta.row] = 0
-        self._dec[meta.row] = {}
-        self._ckpt_slot[meta.row] = -1
-        if initial_state:
-            self.app.restore(name, initial_state)
+            np.asarray([m.row for m in metas], np.int32),
+            np.asarray([len(m.members) for m in metas], np.int32),
+            np.full(len(metas), version, np.int32),
+            np.asarray(bals, np.int32),
+            np.asarray([c == self.id for c in coords]))
+        for meta, bal in zip(metas, bals):
+            self._bal_seen[meta.row] = bal
+            self._cursor[meta.row] = 0
+            self._dec[meta.row] = {}
+            self._ckpt_slot[meta.row] = -1
+            if initial_state:
+                self.app.restore(meta.name, initial_state)
         if durable:
-            self.logger.put_group(meta.gkey, name, version, members)
-            self.logger.checkpoint(CheckpointRec(
-                meta.gkey, name, version, members, -1,
-                self.app.checkpoint(name)))
-        return True
+            self.logger.put_groups(
+                [(m.gkey, m.name, m.version, m.members) for m in metas])
+            self.logger.checkpoint_many(
+                [CheckpointRec(m.gkey, m.name, m.version, m.members, -1,
+                               self.app.checkpoint(m.name))
+                 for m in metas])
+        return len(metas)
 
     def delete_group(self, name: str) -> bool:
-        meta = self.table.by_name(name)
-        if meta is None:
-            return False
-        self.backend.delete(np.asarray([meta.row], np.int32))
-        self.table.delete(meta.gkey)
-        for d in (self._bal_seen, self._cursor, self._dec, self._ckpt_slot):
-            d.pop(meta.row, None)
-        self._elections.pop(meta.row, None)
-        self._group_stopped.discard(meta.row)
-        self.logger.delete_group(meta.gkey)
-        self.logger.delete_checkpoint(meta.gkey)
-        self.app.restore(meta.name, b"")
-        return True
+        return self.delete_groups([name]) == 1
+
+    def delete_groups(self, names: List[str]) -> int:
+        """Batched delete: ONE device scatter + ONE durable txn."""
+        metas_by_key = {m.gkey: m
+                        for m in (self.table.by_name(n) for n in names)
+                        if m is not None}  # dedupe repeated names
+        metas = list(metas_by_key.values())
+        if not metas:
+            return 0
+        self.backend.delete(
+            np.asarray([m.row for m in metas], np.int32))
+        for meta in metas:
+            self.table.delete(meta.gkey)
+            for d in (self._bal_seen, self._cursor, self._dec,
+                      self._ckpt_slot):
+                d.pop(meta.row, None)
+            self._elections.pop(meta.row, None)
+            self._group_stopped.discard(meta.row)
+        self.logger.delete_groups([m.gkey for m in metas])
+        for meta in metas:
+            self.app.restore(meta.name, b"")
+        return len(metas)
 
     # ------------------------------------------------------------------
     # intake

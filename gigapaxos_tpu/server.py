@@ -1,0 +1,132 @@
+"""Server process entry point.
+
+Reference analog: ``bin/gpServer.sh`` wrapping ``reconfiguration/
+ReconfigurableNode.main`` — boots the roles a node id holds per the
+properties file and runs until SIGTERM/SIGINT.
+
+Usage::
+
+    python -m gigapaxos_tpu.server --config conf/gigapaxos.properties \
+        --id 0 --logdir /var/tmp/gp
+
+Properties file (ref: ``gigapaxos.properties``)::
+
+    # node map
+    active.0=127.0.0.1:2000
+    active.1=127.0.0.1:2001
+    active.2=127.0.0.1:2002
+    reconfigurator.100=127.0.0.1:3000
+    # app (module:Class implementing Replicable), default KVApp
+    APPLICATION=gigapaxos_tpu.examples.chatapp:ChatApp
+    # optional knobs mirrored into Config (ref: PaxosConfig PC enum)
+    CAPACITY=1048576
+    WINDOW=16
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib
+import signal
+import sys
+import threading
+from typing import Callable, Dict
+
+from gigapaxos_tpu.paxos.interfaces import (CounterApp, KVApp, NoopApp,
+                                            Replicable)
+from gigapaxos_tpu.reconfiguration.node import NodeConfig, ReconfigurableNode
+from gigapaxos_tpu.utils.logutil import get_logger
+
+log = get_logger("gp.server")
+
+_BUILTIN_APPS: Dict[str, Callable[[], Replicable]] = {
+    "NoopApp": NoopApp,
+    "CounterApp": CounterApp,
+    "KVApp": KVApp,
+}
+
+
+def load_app(spec: str) -> Callable[[], Replicable]:
+    """Resolve an app factory: a builtin name or ``module:Class``
+    (ref: the properties file's ``APPLICATION=`` key)."""
+    if spec in _BUILTIN_APPS:
+        return _BUILTIN_APPS[spec]
+    if ":" not in spec:
+        raise SystemExit(
+            f"unknown app {spec!r}; builtins: {sorted(_BUILTIN_APPS)} "
+            "or module:Class")
+    mod, cls = spec.split(":", 1)
+    factory = getattr(importlib.import_module(mod), cls)
+    if not (isinstance(factory, type) and issubclass(factory, Replicable)):
+        raise SystemExit(f"{spec} is not a Replicable subclass")
+    return factory
+
+
+def read_extras(path: str) -> Dict[str, str]:
+    """Non-node-map keys from the properties file (APPLICATION, knobs)."""
+    extras: Dict[str, str] = {}
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line or line.startswith("#") or "=" not in line:
+                continue
+            k, v = (s.strip() for s in line.split("=", 1))
+            if not (k.startswith("active.")
+                    or k.startswith("reconfigurator.")):
+                extras[k] = v
+    return extras
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="gigapaxos_tpu.server",
+        description="Boot one gigapaxos-tpu node (active replica and/or "
+                    "reconfigurator roles per the properties file).")
+    p.add_argument("--config", required=True,
+                   help="properties file with the node map")
+    p.add_argument("--id", type=int, required=True, help="this node's id")
+    p.add_argument("--logdir", default="/tmp/gigapaxos_tpu",
+                   help="WAL/checkpoint directory")
+    p.add_argument("--app", default=None,
+                   help="override APPLICATION from the properties file")
+    args = p.parse_args(argv)
+
+    extras = read_extras(args.config)
+    cfg_kw = {}
+    if "ACTIVES_PER_NAME" in extras:
+        cfg_kw["actives_per_name"] = int(extras["ACTIVES_PER_NAME"])
+    if "RC_GROUP_SIZE" in extras:
+        cfg_kw["rc_group_size"] = int(extras["RC_GROUP_SIZE"])
+    config = NodeConfig.from_properties(args.config, **cfg_kw)
+
+    node_kw = {}
+    if "CAPACITY" in extras:
+        node_kw["capacity"] = int(extras["CAPACITY"])
+    if "WINDOW" in extras:
+        node_kw["window"] = int(extras["WINDOW"])
+    if "BACKEND" in extras:  # "columnar" (device) | "scalar" (host numpy)
+        node_kw["backend"] = extras["BACKEND"]
+
+    app_spec = args.app or extras.get("APPLICATION", "KVApp")
+    app_factory = load_app(app_spec)
+
+    node = ReconfigurableNode(args.id, config, app_factory, args.logdir,
+                              **node_kw)
+    roles = [r for r, x in (("active", node.active),
+                            ("reconfigurator", node.reconfigurator)) if x]
+    log.info("node %d starting roles=%s app=%s", args.id, roles, app_spec)
+    node.start()
+
+    stop = threading.Event()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        signal.signal(sig, lambda *_: stop.set())
+    try:
+        stop.wait()
+    finally:
+        log.info("node %d stopping", args.id)
+        node.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

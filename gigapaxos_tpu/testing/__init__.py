@@ -1,0 +1,11 @@
+"""Single-process multi-node emulation + benchmark harness.
+
+Reference analog: ``gigapaxos/testing/`` — ``TESTPaxosMain`` (N managers
+in one JVM, real loopback sockets), ``TESTPaxosClient`` (load generation,
+throughput/latency aggregates), ``TESTPaxosConfig`` (node count, group
+count, failure injection).  See SURVEY.md §4.2–§4.5.
+"""
+
+from gigapaxos_tpu.testing.harness import PaxosEmulation
+
+__all__ = ["PaxosEmulation"]

@@ -1,0 +1,169 @@
+"""In-process multi-node cluster + load generator.
+
+Ref: ``gigapaxos/testing/TESTPaxosMain.java`` (single-JVM multi-node
+emulation over REAL loopback sockets — no transport fakes, SURVEY.md
+§4.2) + ``TESTPaxosClient`` (throughput/latency measurement) +
+``TESTPaxosConfig`` (fault injection: message drops, node crash).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import socket
+import time
+from typing import Dict, List, Optional, Tuple, Type
+
+import numpy as np
+
+from gigapaxos_tpu.paxos.client import PaxosClientAsync
+from gigapaxos_tpu.paxos.interfaces import NoopApp, Replicable
+from gigapaxos_tpu.paxos.manager import PaxosNode
+from gigapaxos_tpu.paxos.paxosconfig import PC
+from gigapaxos_tpu.utils.config import Config
+
+
+def free_ports(n: int) -> List[int]:
+    socks = [socket.socket() for _ in range(n)]
+    for s in socks:
+        s.bind(("127.0.0.1", 0))
+    ports = [s.getsockname()[1] for s in socks]
+    for s in socks:
+        s.close()
+    return ports
+
+
+class PaxosEmulation:
+    """N paxos nodes in one process; groups pre-created on all members.
+
+    ``group_size`` members per group (first ``group_size`` nodes by
+    name-hash rotation), so >3-node emulations exercise overlapping
+    quorums like the reference's TESTPaxos defaults.
+    """
+
+    def __init__(self, logdir: str, n_nodes: int = 3,
+                 n_groups: int = 1000, group_size: int = 3,
+                 backend: str = "columnar",
+                 app_cls: Type[Replicable] = NoopApp,
+                 capacity: int = 1 << 16, window: int = 16,
+                 sync_wal: bool = False,
+                 ping_interval_s: Optional[float] = None,
+                 failure_timeout_s: Optional[float] = None):
+        Config.set(PC.SYNC_WAL, sync_wal)
+        if ping_interval_s is not None:
+            Config.set(PC.PING_INTERVAL_S, ping_interval_s)
+        if failure_timeout_s is not None:
+            Config.set(PC.FAILURE_TIMEOUT_S, failure_timeout_s)
+        self.logdir = logdir
+        self.n_nodes = n_nodes
+        self.group_size = min(group_size, n_nodes)
+        self.backend = backend
+        self.app_cls = app_cls
+        self.capacity = capacity
+        self.window = window
+        ports = free_ports(n_nodes)
+        self.addr_map: Dict[int, Tuple[str, int]] = {
+            i: ("127.0.0.1", ports[i]) for i in range(n_nodes)}
+        self.nodes: Dict[int, Optional[PaxosNode]] = {}
+        for i in range(n_nodes):
+            self._boot(i)
+        self.groups: List[str] = []
+        if n_groups:
+            self.create_groups(n_groups)
+
+    def _boot(self, i: int) -> PaxosNode:
+        node = PaxosNode(i, self.addr_map, self.app_cls(),
+                         f"{self.logdir}/n{i}", backend=self.backend,
+                         capacity=self.capacity, window=self.window)
+        node.start()
+        self.nodes[i] = node
+        return node
+
+    def members_of(self, name: str) -> Tuple[int, ...]:
+        if self.n_nodes == self.group_size:
+            return tuple(range(self.n_nodes))
+        start = hash(name) % self.n_nodes
+        return tuple(sorted((start + j) % self.n_nodes
+                            for j in range(self.group_size)))
+
+    def create_groups(self, n: int, prefix: str = "g") -> List[str]:
+        names = [f"{prefix}{i}" for i in range(n)]
+        per_node: Dict[int, List] = {}
+        for name in names:
+            mem = self.members_of(name)
+            for m in mem:
+                per_node.setdefault(m, []).append((name, mem))
+        for m, items in per_node.items():
+            self.nodes[m].create_groups(items)
+        self.groups.extend(names)
+        return names
+
+    # -- fault injection (ref: TESTPaxosConfig) -------------------------
+
+    def set_drop_rate(self, node: int, rate: float) -> None:
+        self.nodes[node].transport.test_drop_rate = rate
+
+    def kill(self, node: int) -> None:
+        """Crash-stop: no final flush, no goodbye (ref: crash emulation)."""
+        self.nodes[node].stop()
+        self.nodes[node] = None
+
+    def restart(self, node: int) -> PaxosNode:
+        """Reboot from the WAL/checkpoint directory (recovery path)."""
+        assert self.nodes[node] is None, "kill() first"
+        return self._boot(node)
+
+    def stop(self) -> None:
+        for nd in self.nodes.values():
+            if nd is not None:
+                nd.stop()
+
+    # -- load generation (ref: TESTPaxosClient) -------------------------
+
+    def run_load(self, n_requests: int, concurrency: int = 64,
+                 payload: bytes = b"x", timeout: float = 15.0,
+                 client_id: int = 1 << 20,
+                 servers: Optional[List[int]] = None) -> Dict:
+        """Round-robin ``n_requests`` over the groups; returns throughput
+        + latency aggregates (ref: TESTPaxosClient's DelayProfiler
+        output)."""
+        groups = self.groups
+        live = [i for i, nd in self.nodes.items() if nd is not None] \
+            if servers is None else servers
+
+        async def body():
+            cli = PaxosClientAsync(
+                client_id, [self.addr_map[i] for i in live],
+                timeout=timeout)
+            lat: List[float] = []
+            errs = [0]
+            sem = asyncio.Semaphore(concurrency)
+
+            async def one(k: int):
+                async with sem:
+                    t0 = time.perf_counter()
+                    try:
+                        r = await cli.send_request(
+                            groups[k % len(groups)], payload)
+                        if r.status != 0:
+                            errs[0] += 1
+                            return
+                        lat.append(time.perf_counter() - t0)
+                    except (TimeoutError, asyncio.TimeoutError):
+                        errs[0] += 1
+            t0 = time.perf_counter()
+            await asyncio.gather(*[one(k) for k in range(n_requests)])
+            wall = time.perf_counter() - t0
+            await cli.close()
+            arr = np.asarray(lat) if lat else np.zeros(1)
+            return {
+                "requests": n_requests,
+                "ok": len(lat),
+                "errors": errs[0],
+                "wall_s": round(wall, 3),
+                "throughput_rps": round(len(lat) / wall, 1),
+                "lat_p50_ms": round(1e3 * float(np.percentile(arr, 50)),
+                                    2),
+                "lat_p99_ms": round(1e3 * float(np.percentile(arr, 99)),
+                                    2),
+            }
+        return asyncio.run(body())

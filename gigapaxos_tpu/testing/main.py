@@ -1,0 +1,139 @@
+"""Benchmark/emulation driver (ref: ``TESTPaxosMain`` +
+``TESTReconfigurationMain``).  Prints ONE json line per run, mirroring
+the BASELINE.json configs that exercise the full stack over real
+loopback sockets (the TPU-kernel headline — config 3 — is bench.py at
+the repo root):
+
+- ``throughput``  config 1: NoopApp, N replicas, K groups, full
+  request→accept→decide→execute→reply path
+- ``churn``       config 4: group create/delete per second
+- ``failover``    config 5: 5-replica quorum, coordinator killed
+  mid-load (prepare-heavy re-election), recovery measured
+
+Usage::
+
+    python -m gigapaxos_tpu.testing.main throughput --groups 1000 \
+        --requests 20000 --backend columnar
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import tempfile
+import time
+
+from gigapaxos_tpu.paxos.packets import group_key
+from gigapaxos_tpu.testing.harness import PaxosEmulation
+
+
+def mode_throughput(args) -> dict:
+    emu = PaxosEmulation(args.logdir, n_nodes=args.nodes,
+                         n_groups=args.groups, backend=args.backend,
+                         capacity=args.capacity, window=args.window,
+                         sync_wal=args.sync_wal)
+    try:
+        emu.run_load(min(2000, args.requests // 10) or 100,
+                     concurrency=args.concurrency)  # warmup
+        stats = emu.run_load(args.requests, concurrency=args.concurrency)
+        return {
+            "metric": f"e2e decided req/s, {args.nodes} replicas, "
+                      f"{args.groups} groups ({args.backend})",
+            "value": stats["throughput_rps"], "unit": "req/s",
+            "info": stats,
+        }
+    finally:
+        emu.stop()
+
+
+def mode_churn(args) -> dict:
+    emu = PaxosEmulation(args.logdir, n_nodes=args.nodes, n_groups=0,
+                         backend=args.backend, capacity=args.capacity,
+                         window=args.window, sync_wal=args.sync_wal)
+    try:
+        n = args.requests
+        chunk = 512  # batched creates/deletes stream (ref: batched
+        # CreateServiceName); chunking models an arrival stream rather
+        # than one giant batch
+        mem = tuple(range(min(3, args.nodes)))
+        t0 = time.perf_counter()
+        for round_ in range(2):
+            names = [f"churn{round_}_{i}" for i in range(n // 2)]
+            for at in range(0, len(names), chunk):
+                part = names[at:at + chunk]
+                for m in mem:
+                    made = emu.nodes[m].create_groups(
+                        [(nm, mem) for nm in part])
+                    assert made == len(part)
+            for at in range(0, len(names), chunk):
+                part = names[at:at + chunk]
+                for m in mem:
+                    gone = emu.nodes[m].delete_groups(part)
+                    assert gone == len(part)
+                    assert emu.nodes[m].table.by_key(
+                        group_key(part[0])) is None
+        wall = time.perf_counter() - t0
+        ops = 2 * (n // 2) * 2  # creates + deletes
+        return {
+            "metric": f"group create+delete ops/s, {args.nodes} nodes "
+                      f"({args.backend})",
+            "value": round(ops / wall, 1), "unit": "ops/s",
+            "info": {"ops": ops, "wall_s": round(wall, 3)},
+        }
+    finally:
+        emu.stop()
+
+
+def mode_failover(args) -> dict:
+    emu = PaxosEmulation(args.logdir, n_nodes=5, n_groups=args.groups,
+                         group_size=5, backend=args.backend,
+                         capacity=args.capacity, window=args.window,
+                         sync_wal=args.sync_wal, ping_interval_s=0.15,
+                         failure_timeout_s=1.0)
+    try:
+        pre = emu.run_load(args.requests, concurrency=args.concurrency)
+        # kill the initial coordinator of group g0's hash majority:
+        # every group's initial coordinator is gkey % 5
+        victim = group_key(emu.groups[0]) % 5
+        time.sleep(0.5)  # let pings establish last_heard
+        emu.kill(victim)
+        t0 = time.perf_counter()
+        post = emu.run_load(args.requests, concurrency=args.concurrency,
+                            timeout=20.0, client_id=1 << 21)
+        t_recover = time.perf_counter() - t0
+        return {
+            "metric": f"e2e req/s across coordinator failover, 5 "
+                      f"replicas ({args.backend})",
+            "value": post["throughput_rps"], "unit": "req/s",
+            "info": {"pre": pre, "post": post, "victim": victim,
+                     "post_wall_s": round(t_recover, 2)},
+        }
+    finally:
+        emu.stop()
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="gigapaxos_tpu.testing.main")
+    p.add_argument("mode", choices=["throughput", "churn", "failover"])
+    p.add_argument("--nodes", type=int, default=3)
+    p.add_argument("--groups", type=int, default=1000)
+    p.add_argument("--requests", type=int, default=20000)
+    p.add_argument("--concurrency", type=int, default=128)
+    p.add_argument("--backend", default="columnar",
+                   choices=["columnar", "scalar"])
+    p.add_argument("--capacity", type=int, default=1 << 16)
+    p.add_argument("--window", type=int, default=16)
+    p.add_argument("--sync-wal", action="store_true")
+    p.add_argument("--logdir", default=None)
+    args = p.parse_args(argv)
+    if args.logdir is None:
+        args.logdir = tempfile.mkdtemp(prefix="gp_bench_")
+    out = {"throughput": mode_throughput, "churn": mode_churn,
+           "failover": mode_failover}[args.mode](args)
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,104 @@
+"""Real-process CLI tests: boot servers via ``python -m
+gigapaxos_tpu.server`` (ref: bin/gpServer.sh) and drive them with
+``python -m gigapaxos_tpu.client_cli`` (ref: bin/gpClient.sh).
+
+Servers run the scalar backend so N subprocesses don't contend for the
+one device; the engine SPI keeps the data planes interchangeable.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_ports(n):
+    socks = [socket.socket() for _ in range(n)]
+    for s in socks:
+        s.bind(("127.0.0.1", 0))
+    ports = [s.getsockname()[1] for s in socks]
+    for s in socks:
+        s.close()
+    return ports
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    ports = _free_ports(4)
+    conf = tmp_path / "gp.properties"
+    conf.write_text(
+        "".join(f"active.{i}=127.0.0.1:{ports[i]}\n" for i in range(3)) +
+        f"reconfigurator.100=127.0.0.1:{ports[3]}\n"
+        "APPLICATION=gigapaxos_tpu.examples.chatapp:ChatApp\n"
+        "CAPACITY=1024\nWINDOW=8\nBACKEND=scalar\nRC_GROUP_SIZE=1\n")
+    env = dict(os.environ, PYTHONPATH=REPO)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-m", "gigapaxos_tpu.server",
+             "--config", str(conf), "--id", str(i),
+             "--logdir", str(tmp_path / "logs")],
+            env=env, stdout=subprocess.DEVNULL, stderr=subprocess.PIPE)
+        for i in (0, 1, 2, 100)]
+    # wait for all listen sockets
+    deadline = time.time() + 30
+    for port in ports:
+        while time.time() < deadline:
+            try:
+                socket.create_connection(("127.0.0.1", port),
+                                         timeout=0.2).close()
+                break
+            except OSError:
+                if any(p.poll() is not None for p in procs):
+                    _dump_and_fail(procs)
+                time.sleep(0.1)
+        else:
+            _dump_and_fail(procs)
+    yield conf
+    for p in procs:
+        p.terminate()
+    for p in procs:
+        try:
+            p.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            p.kill()
+
+
+def _dump_and_fail(procs):
+    errs = []
+    for p in procs:
+        p.terminate()
+        try:
+            _, err = p.communicate(timeout=5)
+            errs.append(err.decode(errors="replace")[-2000:])
+        except subprocess.TimeoutExpired:
+            p.kill()
+    pytest.fail("server process died or never listened:\n" +
+                "\n---\n".join(errs))
+
+
+def _cli(conf, *args, timeout=30):
+    out = subprocess.run(
+        [sys.executable, "-m", "gigapaxos_tpu.client_cli",
+         "--config", str(conf), *args],
+        env=dict(os.environ, PYTHONPATH=REPO), capture_output=True,
+        timeout=timeout)
+    assert out.returncode == 0, out.stderr.decode(errors="replace")
+    return out.stdout.decode().strip()
+
+
+def test_server_client_chat_lifecycle(cluster):
+    conf = cluster
+    assert _cli(conf, "create", "room1") == "created"
+    actives = _cli(conf, "actives", "room1").split()
+    assert len(actives) == 3
+    r = _cli(conf, "send", "room1",
+             '{"op":"post","who":"alice","msg":"hello tpu"}')
+    assert '"ok": true' in r and '"seq": 1' in r
+    r = _cli(conf, "send", "room1", '{"op":"read","n":5}')
+    assert "hello tpu" in r
+    assert _cli(conf, "delete", "room1") == "deleted"
