@@ -74,6 +74,9 @@ async def _amain(args) -> int:
         try:
             print(await _run_one(cli, args.cmd, args.args))
             return 0
+        except (ValueError, IndexError) as e:
+            print(f"usage error: {e}", file=sys.stderr)
+            return 2
         except (KeyError, TimeoutError) as e:
             print(f"error: {e}", file=sys.stderr)
             return 1

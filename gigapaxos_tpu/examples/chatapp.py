@@ -33,19 +33,25 @@ class ChatApp(Replicable):
             cmd = json.loads(payload.decode())
         except (ValueError, UnicodeDecodeError):
             return b'{"err":"bad request"}'
+        if not isinstance(cmd, dict):
+            return b'{"err":"bad request"}'
         with self._lock:
             room = self.rooms.setdefault(name, [])
             if cmd.get("op") == "post":
                 seq = self.seqs.get(name, 0) + 1
                 self.seqs[name] = seq
-                room.append({"seq": seq, "who": cmd.get("who", "?"),
-                             "msg": cmd.get("msg", "")})
+                room.append({"seq": seq, "who": str(cmd.get("who", "?")),
+                             "msg": str(cmd.get("msg", ""))})
                 del room[:-self.MAX_LOG]
                 return json.dumps({"ok": True, "seq": seq}).encode()
             if cmd.get("op") == "read":
-                n = int(cmd.get("n", 10))
+                try:
+                    n = max(0, int(cmd.get("n", 10)))
+                except (TypeError, ValueError):
+                    return b'{"err":"bad n"}'
                 return json.dumps({"ok": True,
-                                   "msgs": room[-n:]}).encode()
+                                   "msgs": room[-n:] if n else []}
+                                  ).encode()
             return b'{"err":"bad op"}'
 
     def checkpoint(self, name) -> bytes:

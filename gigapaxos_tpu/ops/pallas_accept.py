@@ -1,0 +1,277 @@
+"""Pallas TPU kernel for the acceptor hot op (HOT LOOP #1).
+
+Reference analog: ``PaxosAcceptor.acceptAndUpdateBallot`` — the
+ballot-compare + window-store transition that every AcceptPacket hits
+(SURVEY.md §3.1).  The XLA path (``kernels.accept_batch``) expresses it
+as 5 separate scatter ops over the ``[G, W]`` state; this kernel fuses
+the whole transition into ONE pass that DMAs each touched 8-row block
+to VMEM once, applies every lane aimed at it, and writes it back.
+
+Key design points (see /opt/skills/guides/pallas_guide.md):
+
+- Mosaic requires block shapes (8k, 128m) or full-dim, so state rows are
+  processed in 8-row blocks ("octiles"): the host groups the batch BY
+  ``row // 8`` (:func:`group_lanes_by_block`), each grid step owns one
+  distinct octile, and the kernel applies lanes to sub-rows with one-hot
+  masks — fully vectorized, no per-lane scalar loop.
+- Distinct octiles per step ⇒ no block is read by a later step after an
+  earlier step wrote it (Pallas prefetches input blocks; a same-block
+  conflict across steps would read stale state).  Grid padding therefore
+  targets an octile ABSENT from the batch, where the all-invalid
+  write-back is a no-op.
+- Octile indices ride in scalar-prefetch SMEM and drive the BlockSpec
+  index maps (the sparse-row-update pattern); lane arrays are small and
+  live whole in VMEM.
+- ``input_output_aliases`` makes the scattered outputs in-place: octiles
+  the grid never visits keep their old contents.
+
+Precondition (same as the XLA path, enforced by the packet batcher): at
+most one lane per (row, slot) per batch.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from gigapaxos_tpu.ops.types import NO_BALLOT, NO_SLOT, ColumnarState
+
+i32 = jnp.int32
+SUB = 8  # octile height; Mosaic's sublane granule for i32
+
+
+def _kernel(blocks_ref,                       # scalar prefetch: i32[Rb]
+            slotL, balL, rloL, rhiL, subL, validL,  # i32[Rb, L] in VMEM
+            bal_in, act_in, cur_in,           # i32[SUB, 1] octile vectors
+            abal_in, aslot_in, alo_in, ahi_in,  # i32[SUB, W] windows
+            bal_out, abal_out, aslot_out, alo_out, ahi_out,
+            lane_out,                         # i32[Rb, 4*L]
+            *, L: int, W: int):
+    i = _pid()
+    lslot = slotL[i, :]
+    lbal = balL[i, :]
+    lsub = subL[i, :]
+    lval = validL[i, :] != 0
+
+    rows8 = jax.lax.broadcasted_iota(i32, (SUB, L), 0)
+    oh_rows = (rows8 == lsub[None, :]) & lval[None, :]     # [SUB, L]
+    active = act_in[:, 0] != 0                             # [SUB]
+    oh = oh_rows & active[:, None]  # mutation gate only
+
+    old_bal = bal_in[:, 0]                                 # [SUB]
+    lane_bal = jnp.where(oh, lbal[None, :], NO_BALLOT)
+    new_bal = jnp.maximum(old_bal, jnp.max(lane_bal, axis=1))
+    bal_out[:, 0] = new_bal
+
+    cursor = cur_in[:, 0]                                  # [SUB]
+    slot2 = jnp.where(oh, lslot[None, :], 0)
+    promised = oh & (lbal[None, :] >= new_bal[:, None])
+    stale = oh & (slot2 < cursor[:, None])
+    in_win = (slot2 >= cursor[:, None]) & \
+        (slot2 < cursor[:, None] + W)
+    store = promised & in_win & ~stale                     # [SUB, L]
+
+    # window scatter via one-hot over W (at most one lane per (row, w))
+    w_of = jnp.where(store, lslot[None, :] % W, -1)        # [SUB, L]
+    colw = jax.lax.broadcasted_iota(i32, (SUB, L, W), 2)
+    hit = colw == w_of[:, :, None]                         # [SUB, L, W]
+    anyhit = jnp.any(hit, axis=1)                          # [SUB, W]
+
+    def put(win_in, win_out, lane_vals):
+        v = jnp.sum(jnp.where(hit, lane_vals[None, :, None], 0), axis=1)
+        win_out[:, :] = jnp.where(anyhit, v, win_in[:, :])
+
+    put(abal_in, abal_out, lbal)
+    put(aslot_in, aslot_out, lslot)
+    put(alo_in, alo_out, rloL[i, :])
+    put(ahi_in, ahi_out, rhiL[i, :])
+
+    acked = store | (promised & stale)
+    out_window = promised & ~in_win & ~stale
+    lane_acked = jnp.any(acked, axis=0)                    # [L]
+    lane_stale = jnp.any(stale, axis=0)
+    lane_ow = jnp.any(out_window, axis=0)
+    # report the row's promise even for inactive rows (matches the XLA
+    # path, which gathers cur_bal regardless of the active gate)
+    lane_bal_out = jnp.sum(jnp.where(oh_rows, new_bal[:, None], 0),
+                           axis=0)
+    lane_out[i, 0 * L:1 * L] = lane_acked.astype(i32)
+    lane_out[i, 1 * L:2 * L] = lane_stale.astype(i32)
+    lane_out[i, 2 * L:3 * L] = lane_ow.astype(i32)
+    lane_out[i, 3 * L:4 * L] = lane_bal_out
+
+
+def _pid():
+    from jax.experimental import pallas as pl
+    return pl.program_id(0)
+
+
+@functools.partial(jax.jit, static_argnums=(14,),
+                   donate_argnums=(1, 10, 11, 12, 13))
+def _accept_blocks(blocks, bal, active, cursor, slotL, balL, rloL, rhiL,
+                   subL, validL, abal, aslot, alo, ahi, interpret: bool):
+    """One fused pass: Rb distinct octiles, up to L lanes each."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    Rb, L = slotL.shape
+    G, W = abal.shape
+    bal2 = bal.reshape(G, 1)
+    act2 = active.astype(i32).reshape(G, 1)
+    cur2 = cursor.reshape(G, 1)
+
+    def oct_map(i, blocks_ref):
+        return (blocks_ref[i], 0)
+
+    def full_map(i, blocks_ref):
+        return (0, 0)
+
+    lane_spec = pl.BlockSpec((Rb, L), full_map)
+    vec_spec = pl.BlockSpec((SUB, 1), oct_map)
+    win_spec = pl.BlockSpec((SUB, W), oct_map)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(Rb,),
+        in_specs=[lane_spec] * 6 + [vec_spec] * 3 + [win_spec] * 4,
+        out_specs=[vec_spec] + [win_spec] * 4 +
+                  [pl.BlockSpec((Rb, 4 * L), full_map)],
+    )
+    out_shape = [
+        jax.ShapeDtypeStruct((G, 1), i32),   # bal
+        jax.ShapeDtypeStruct((G, W), i32),   # abal
+        jax.ShapeDtypeStruct((G, W), i32),   # aslot
+        jax.ShapeDtypeStruct((G, W), i32),   # alo
+        jax.ShapeDtypeStruct((G, W), i32),   # ahi
+        jax.ShapeDtypeStruct((Rb, 4 * L), i32),
+    ]
+    outs = pl.pallas_call(
+        functools.partial(_kernel, L=L, W=W),
+        grid_spec=grid_spec,
+        out_shape=out_shape,
+        # operand order: blocks, 6 lane arrays, bal2, act2, cur2,
+        # 4 windows → outputs 0-4 alias bal2 + windows
+        input_output_aliases={7: 0, 10: 1, 11: 2, 12: 3, 13: 4},
+        interpret=interpret,
+    )(blocks, slotL, balL, rloL, rhiL, subL, validL, bal2, act2, cur2,
+      abal, aslot, alo, ahi)
+    bal_n, abal_n, aslot_n, alo_n, ahi_n, lane_out = outs
+    return bal_n.reshape(G), abal_n, aslot_n, alo_n, ahi_n, lane_out
+
+
+def group_lanes_by_block(rows: np.ndarray, L: int
+                         ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Host-side: (unique_blocks[R], lane_index[R, L], overflow[B]).
+
+    ``lane_index[r, j]`` is the batch index of the j-th lane aimed at
+    octile ``unique_blocks[r]`` (-1 padding).  Lanes beyond L per octile
+    are reported in ``overflow`` for a follow-up call.
+    """
+    blocks = rows // SUB
+    order = np.argsort(blocks, kind="stable")
+    sb = blocks[order]
+    B = len(rows)
+    starts = np.ones(B, bool)
+    starts[1:] = sb[1:] != sb[:-1]
+    seg = np.cumsum(starts) - 1
+    run_start = np.flatnonzero(starts)
+    rank = np.arange(B) - run_start[seg]
+    R = len(run_start)
+    lane_index = np.full((R, L), -1, np.int64)
+    ok = rank < L
+    lane_index[seg[ok], rank[ok]] = order[ok]
+    overflow = np.zeros(B, bool)
+    overflow[order[~ok]] = True
+    return sb[run_start], lane_index, overflow
+
+
+class PallasAccept:
+    """Drives the fused kernel; pads R to power-of-two buckets.
+
+    ``interpret=True`` runs the Pallas interpreter (CPU tests); real-TPU
+    callers probe one compile at init and fall back to the XLA scatter
+    path if Mosaic rejects the shapes.
+    """
+
+    def __init__(self, L: int = 16, interpret: bool = False):
+        self.L = L
+        self.interpret = interpret
+
+    def __call__(self, state: ColumnarState, g: np.ndarray,
+                 slot: np.ndarray, bal: np.ndarray, rlo: np.ndarray,
+                 rhi: np.ndarray, valid: np.ndarray):
+        """Returns (new_state, (acked, stale, out_window, cur_bal))
+        matching ``kernels.accept_batch`` host-side semantics."""
+        B = len(g)
+        acked = np.zeros(B, bool)
+        stale = np.zeros(B, bool)
+        out_win = np.zeros(B, bool)
+        cur_bal = np.full(B, NO_BALLOT, np.int32)
+        todo = np.asarray(valid, bool).copy()
+        G = int(state.bal.shape[0])
+        n_blocks = G // SUB
+        while todo.any():
+            idx = np.flatnonzero(todo)
+            blocks_u, lane_index, overflow = group_lanes_by_block(
+                np.asarray(g)[idx], self.L)
+            sel = lane_index.reshape(-1)
+            padded = sel < 0
+            sel = np.where(padded, 0, sel)
+            take = idx[sel]
+
+            R = len(blocks_u)
+            Rb = max(8, 1 << (R - 1).bit_length())
+            if Rb > n_blocks:
+                Rb = R  # every octile is in the batch: no padding
+            pad_r = Rb - R
+            # padded grid steps MUST target an octile absent from the
+            # batch: a duplicate octile across steps reads its block
+            # from the stale INPUT array and would overwrite the real
+            # step's output.  Absent octile ⇒ all-invalid write-back is
+            # a no-op.
+            pad_block = 0
+            if pad_r:
+                if blocks_u[-1] != n_blocks - 1:
+                    pad_block = n_blocks - 1
+                else:
+                    gaps = np.flatnonzero(np.diff(blocks_u) > 1)
+                    pad_block = (int(blocks_u[gaps[0]]) + 1 if len(gaps)
+                                 else int(blocks_u[0]) - 1)
+
+            def lanes(col, fill):
+                a = np.asarray(col)[take].astype(np.int32).reshape(
+                    -1, self.L)
+                a = np.where(padded.reshape(-1, self.L), fill, a)
+                return np.pad(a, ((0, pad_r), (0, 0)),
+                              constant_values=fill)
+
+            blocks_p = np.pad(blocks_u.astype(np.int32), (0, pad_r),
+                              constant_values=pad_block)
+            new = _accept_blocks(
+                jnp.asarray(blocks_p), state.bal, state.active,
+                state.exec_cursor, jnp.asarray(lanes(slot, NO_SLOT)),
+                jnp.asarray(lanes(bal, NO_BALLOT)),
+                jnp.asarray(lanes(rlo, 0)), jnp.asarray(lanes(rhi, 0)),
+                jnp.asarray(lanes(np.asarray(g) % SUB, 0)),
+                jnp.asarray(lanes(np.ones(B, np.int32), 0)),
+                state.acc_bal, state.acc_slot,
+                state.acc_req_lo, state.acc_req_hi, self.interpret)
+            bal_n, abal_n, aslot_n, alo_n, ahi_n, lane_out = new
+            state = state._replace(bal=bal_n, acc_bal=abal_n,
+                                   acc_slot=aslot_n, acc_req_lo=alo_n,
+                                   acc_req_hi=ahi_n)
+            lo = np.asarray(lane_out)[:R].reshape(R, 4, self.L)
+            live = ~padded.reshape(R, self.L)
+            flat = lane_index.reshape(-1)[live.reshape(-1)]
+            dst = idx[flat]
+            acked[dst] = lo[:, 0, :][live] != 0
+            stale[dst] = lo[:, 1, :][live] != 0
+            out_win[dst] = lo[:, 2, :][live] != 0
+            cur_bal[dst] = lo[:, 3, :][live]
+            todo = np.zeros(B, bool)
+            todo[idx[overflow]] = True
+        return state, (acked, stale, out_win, cur_bal)

@@ -334,7 +334,8 @@ class ColumnarBackend(AcceptorBackend):
     live batch size or group occupancy (SURVEY §7.3.1).
     """
 
-    def __init__(self, capacity: int, window: int = 16):
+    def __init__(self, capacity: int, window: int = 16,
+                 use_pallas_accept: Optional[bool] = None):
         import jax
         from gigapaxos_tpu.ops import kernels, make_state
         self._jax = jax
@@ -342,6 +343,28 @@ class ColumnarBackend(AcceptorBackend):
         self.state = make_state(capacity, window)
         self._window = window
         self.capacity = capacity
+        # fused Pallas accept path (ops/pallas_accept.py): opt-in via
+        # arg or PC.USE_PALLAS_ACCEPT; one probe call decides — Mosaic
+        # constraints or a CPU-only build fall back to the XLA scatters
+        self._pallas = None
+        from gigapaxos_tpu.utils.config import Config
+        from gigapaxos_tpu.paxos.paxosconfig import PC
+        if use_pallas_accept is None:
+            use_pallas_accept = bool(Config.get(PC.USE_PALLAS_ACCEPT))
+        if use_pallas_accept:
+            try:
+                from gigapaxos_tpu.ops.pallas_accept import PallasAccept
+                on_tpu = jax.devices()[0].platform != "cpu"
+                pal = PallasAccept(interpret=not on_tpu)
+                probe = np.zeros(1, np.int32)
+                st, _out = pal(self.state, probe, probe, probe, probe,
+                               probe, np.ones(1, bool))
+                self.state = st
+                self._pallas = pal
+            except Exception:  # pragma: no cover - device-dependent
+                from gigapaxos_tpu.utils.logutil import get_logger
+                get_logger("gp.backend").exception(
+                    "pallas accept unavailable; using XLA scatter path")
 
     @property
     def window(self) -> int:
@@ -399,6 +422,12 @@ class ColumnarBackend(AcceptorBackend):
     def accept(self, rows, slots, bals, req_ids) -> AcceptRes:
         n = len(rows)
         lo, hi = _split64(req_ids)
+        if self._pallas is not None:
+            self.state, (acked, stale, ow, cur_bal) = self._pallas(
+                self.state, np.asarray(rows, np.int32),
+                np.asarray(slots, np.int32), np.asarray(bals, np.int32),
+                lo, hi, np.ones(n, bool))
+            return AcceptRes(acked, stale, ow, cur_bal)
         self.state, o = self._k.accept_p(self.state, self._packed(
             n, (rows, 0), (slots, NO_SLOT), (bals, NO_BALLOT), (lo, 0),
             (hi, 0)))

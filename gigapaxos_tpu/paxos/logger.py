@@ -317,10 +317,22 @@ class PaxosLogger:
 
     # -- lifecycle ---------------------------------------------------------
 
-    def close(self) -> None:
+    def close(self, discard: bool = False) -> None:
+        """``discard=True`` emulates a crash: queued-but-unwritten WAL
+        batches are dropped (their futures fail) instead of being
+        flushed — recovery then sees only what was already durable."""
         if self._closed:
             return
         self._closed = True
+        if discard:
+            try:
+                while True:
+                    item = self._q.get_nowait()
+                    if item is not None:
+                        item[1].set_exception(
+                            RuntimeError("logger aborted"))
+            except queue.Empty:
+                pass
         self._q.put(None)
         self._writer.join(timeout=5)
         # drain anything enqueued behind the sentinel: fail its futures

@@ -177,8 +177,18 @@ class PaxosNode:
             target=self._worker_loop, daemon=True, name=f"gp-work-{self.id}")
         self._worker_thread.start()
 
-    def stop(self) -> None:
+    def stop(self, abort: bool = False) -> None:
+        """Graceful stop, or crash-stop with ``abort=True``: pending
+        inbound packets and queued-but-unfsynced WAL writes are DROPPED,
+        emulating a real crash for recovery tests (ref: TESTPaxosConfig
+        crash emulation)."""
         self._stopping = True
+        if abort:
+            try:
+                while True:
+                    self._inq.get_nowait()
+            except queue_mod.Empty:
+                pass
         self._inq.put(None)
         if self._worker_thread:
             self._worker_thread.join(5)
@@ -186,7 +196,7 @@ class PaxosNode:
             self._loop.call_soon_threadsafe(self._ping_task.cancel)
             self._loop.call_soon_threadsafe(self._loop.stop)
             self._loop_thread.join(5)
-        self.logger.close()
+        self.logger.close(discard=abort)
 
     @property
     def port(self) -> int:
@@ -822,8 +832,17 @@ class PaxosNode:
                 # the group and retry (ref: stopped-instance handling)
                 resp, status = b"", 3
             else:
-                resp = self.app.execute(meta.name, req_id, payload,
-                                        bool(flags & FLAG_STOP))
+                try:
+                    resp = self.app.execute(meta.name, req_id, payload,
+                                            bool(flags & FLAG_STOP))
+                except Exception:
+                    # an app exception is deterministic (same payload on
+                    # every replica): answer with an error and ADVANCE —
+                    # leaving the slot unexecuted would wedge the group
+                    # on all replicas forever
+                    log.exception("app.execute failed for %s slot %d",
+                                  meta.name, cur)
+                    resp, status = b'{"err":"app exception"}', 4
                 if flags & FLAG_STOP:
                     self._group_stopped.add(row)
             self.n_executed += 1

@@ -25,6 +25,9 @@ class PC(ConfigKey):
     CHECKPOINT_INTERVAL = 400
     # backend: "columnar" (JAX/TPU) or "scalar" (per-instance baseline)
     BACKEND = "columnar"
+    # fused Pallas kernel for the acceptor transition (HOT #1); falls
+    # back to the XLA scatter path if Mosaic rejects the shapes
+    USE_PALLAS_ACCEPT = False
     # fsync WAL batches before acking accepts (the durability contract)
     SYNC_WAL = True
     # failure detection

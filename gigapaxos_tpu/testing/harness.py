@@ -103,8 +103,9 @@ class PaxosEmulation:
         self.nodes[node].transport.test_drop_rate = rate
 
     def kill(self, node: int) -> None:
-        """Crash-stop: no final flush, no goodbye (ref: crash emulation)."""
-        self.nodes[node].stop()
+        """Crash-stop: pending packets and unfsynced WAL writes are
+        dropped, no goodbye (ref: TESTPaxosConfig crash emulation)."""
+        self.nodes[node].stop(abort=True)
         self.nodes[node] = None
 
     def restart(self, node: int) -> PaxosNode:
@@ -154,16 +155,18 @@ class PaxosEmulation:
             await asyncio.gather(*[one(k) for k in range(n_requests)])
             wall = time.perf_counter() - t0
             await cli.close()
-            arr = np.asarray(lat) if lat else np.zeros(1)
+            arr = np.asarray(lat)
             return {
                 "requests": n_requests,
                 "ok": len(lat),
                 "errors": errs[0],
                 "wall_s": round(wall, 3),
                 "throughput_rps": round(len(lat) / wall, 1),
+                # None (not 0.0) when nothing succeeded: an all-failing
+                # run must not read as an infinitely fast one
                 "lat_p50_ms": round(1e3 * float(np.percentile(arr, 50)),
-                                    2),
+                                    2) if lat else None,
                 "lat_p99_ms": round(1e3 * float(np.percentile(arr, 99)),
-                                    2),
+                                    2) if lat else None,
             }
         return asyncio.run(body())
