@@ -213,6 +213,8 @@ class PallasAccept:
         cur_bal = np.full(B, NO_BALLOT, np.int32)
         todo = np.asarray(valid, bool).copy()
         G = int(state.bal.shape[0])
+        if G % SUB != 0:
+            raise ValueError(f"capacity {G} not a multiple of {SUB}")
         n_blocks = G // SUB
         while todo.any():
             idx = np.flatnonzero(todo)

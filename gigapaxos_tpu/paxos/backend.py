@@ -21,7 +21,7 @@ builds them straight from decoded struct-of-arrays packets.
 from __future__ import annotations
 
 import abc
-from typing import Dict, NamedTuple, Optional
+from typing import Dict, List, NamedTuple, Optional
 
 import numpy as np
 
@@ -131,6 +131,11 @@ class AcceptorBackend(abc.ABC):
 
     @abc.abstractmethod
     def restore_row(self, row: int, snap: dict) -> None: ...
+
+    def snapshot_rows(self, rows) -> List[dict]:
+        """Batched snapshot (deactivator sweeps); backends override when
+        they can gather many rows in one device round trip."""
+        return [self.snapshot_row(int(r)) for r in rows]
 
 
 # --------------------------------------------------------------------------
@@ -351,6 +356,10 @@ class ColumnarBackend(AcceptorBackend):
         from gigapaxos_tpu.paxos.paxosconfig import PC
         if use_pallas_accept is None:
             use_pallas_accept = bool(Config.get(PC.USE_PALLAS_ACCEPT))
+        if use_pallas_accept and capacity % 8 != 0:
+            # the octile kernel requires G % 8 == 0 (a partial last
+            # octile would let grid padding alias a real one)
+            use_pallas_accept = False
         if use_pallas_accept:
             try:
                 from gigapaxos_tpu.ops.pallas_accept import PallasAccept
@@ -521,19 +530,28 @@ class ColumnarBackend(AcceptorBackend):
         return int(self.state.exec_cursor[row])
 
     def snapshot_row(self, row: int) -> dict:
+        return self.snapshot_rows([row])[0]
+
+    def snapshot_rows(self, rows) -> List[dict]:
+        """ONE gather + ONE device->host transfer for the whole sweep."""
         from gigapaxos_tpu.ops.kernels import gather_rows
         import jax
-        r = gather_rows(self.state, np.asarray([row], np.int32))
+        r = gather_rows(self.state, np.asarray(rows, np.int32))
         host = jax.device_get(r)
-        return {f: np.asarray(v[0]) for f, v in zip(host._fields, host)}
+        return [{f: np.asarray(v[i]) for f, v in zip(host._fields, host)}
+                for i in range(len(rows))]
 
     def restore_row(self, row: int, snap: dict) -> None:
         import jax.numpy as jnp
         from gigapaxos_tpu.ops.types import ColumnarState
         from gigapaxos_tpu.ops.kernels import scatter_rows
+        # coerce dtypes: snapshots may round-trip through JSON (pause
+        # blobs), which turns u32 vote words / bool flags into int lists
         row_state = ColumnarState(
-            **{f: jnp.asarray(snap[f])[None] for f in
-               ColumnarState._fields})
+            **{f: jnp.asarray(
+                np.asarray(snap[f]).astype(
+                    getattr(self.state, f).dtype))[None]
+               for f in ColumnarState._fields})
         self.state, _ = scatter_rows(
             self.state, jnp.asarray([row], jnp.int32), row_state,
             jnp.asarray([True]))

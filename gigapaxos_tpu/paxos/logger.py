@@ -298,10 +298,39 @@ class PaxosLogger:
     # -- pause table (ref: DiskMap + hot-restore pause table) --------------
 
     def pause(self, gkey: int, hot: bytes) -> None:
+        self.pause_many([(gkey, hot)])
+
+    def pause_many(self, items: List[Tuple[int, bytes]]) -> None:
+        """Batched pause: ONE txn for n groups (the deactivator pauses in
+        sweeps; a commit per group would stall the worker)."""
         with self._db_lock:
-            self._db.execute("INSERT OR REPLACE INTO pause VALUES (?,?)",
-                             (_signed(gkey), hot))
+            self._db.executemany(
+                "INSERT OR REPLACE INTO pause VALUES (?,?)",
+                [(_signed(g), h) for g, h in items])
             self._db.commit()
+
+    def peek_pause(self, gkey: int) -> Optional[bytes]:
+        """Read a pause blob WITHOUT deleting it — the caller deletes via
+        :meth:`delete_pause` only after hydration succeeds, so a failed
+        unpause never strands the group."""
+        with self._db_lock:
+            row = self._db.execute(
+                "SELECT hot FROM pause WHERE gkey=?",
+                (_signed(gkey),)).fetchone()
+        return None if row is None else row[0]
+
+    def delete_pause(self, gkey: int) -> None:
+        with self._db_lock:
+            self._db.execute("DELETE FROM pause WHERE gkey=?",
+                             (_signed(gkey),))
+            self._db.commit()
+
+    def paused_keys(self) -> List[int]:
+        """gkeys of all paused groups (recovery must know them so it can
+        leave their rows unhydrated; ref: pause table scan)."""
+        with self._db_lock:
+            rows = self._db.execute("SELECT gkey FROM pause").fetchall()
+        return [_unsigned(r[0]) for r in rows]
 
     def unpause(self, gkey: int) -> Optional[bytes]:
         with self._db_lock:

@@ -26,6 +26,8 @@ the reference's per-instance synchronized blocks.
 
 from __future__ import annotations
 
+import base64
+import json
 import threading
 import time
 import queue as queue_mod
@@ -122,6 +124,14 @@ class PaxosNode:
         self._resp_cache: Dict[int, bytes] = {}
         self._elections: Dict[int, _Election] = {}
 
+        # deactivator (ref: DiskMap pause/unpause + HotRestoreInfo):
+        # idle groups are serialized to the durable pause table and their
+        # device row freed; packets for a paused group unpause on demand
+        self._paused: Set[int] = set()
+        self._last_active: Dict[int, float] = {}
+        self.pause_idle_s = float(Config.get(PC.PAUSE_IDLE_S))
+        self.pause_max_per_tick = int(Config.get(PC.PAUSE_MAX_PER_TICK))
+
         # failure detection (ref: gigapaxos/FailureDetection.java)
         self._last_heard: Dict[int, float] = {}
         self.ping_interval = float(Config.get(PC.PING_INTERVAL_S))
@@ -147,6 +157,8 @@ class PaxosNode:
         # counters
         self.n_executed = 0
         self.n_decided = 0
+        self.n_paused = 0
+        self.n_unpaused = 0
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -155,6 +167,7 @@ class PaxosNode:
     def start(self) -> None:
         """Boot: recover from the durable log, open sockets, start the
         worker (ref: §3.2 boot & crash recovery)."""
+        self._boot_ts = time.time()
         self._recover()
         import asyncio
 
@@ -226,8 +239,9 @@ class PaxosNode:
         metas = []
         try:
             for name, members in items:
-                if self.table.by_name(name) is not None:
-                    continue
+                if (self.table.by_name(name) is not None
+                        or pkt.group_key(name) in self._paused):
+                    continue  # exists (possibly paused)
                 meta = self.table.create(name, members, version)
                 self._group_stopped.discard(meta.row)  # recycled rows
                 metas.append(meta)
@@ -248,11 +262,14 @@ class PaxosNode:
             np.full(len(metas), version, np.int32),
             np.asarray(bals, np.int32),
             np.asarray([c == self.id for c in coords]))
+        now = time.time()
         for meta, bal in zip(metas, bals):
             self._bal_seen[meta.row] = bal
             self._cursor[meta.row] = 0
             self._dec[meta.row] = {}
             self._ckpt_slot[meta.row] = -1
+            # idle-from-birth groups must still be pause-eligible
+            self._last_active[meta.row] = now
             if initial_state:
                 self.app.restore(meta.name, initial_state)
         if durable:
@@ -268,13 +285,23 @@ class PaxosNode:
         return self.delete_groups([name]) == 1
 
     def delete_groups(self, names: List[str]) -> int:
-        """Batched delete: ONE device scatter + ONE durable txn."""
+        """Batched delete: ONE device scatter + ONE durable txn.
+        Paused groups delete without hydration (their pause record goes
+        with the birth record)."""
+        paused_gone = []
+        for n in dict.fromkeys(names):  # dedupe, order-preserving
+            gk = pkt.group_key(n)
+            if gk in self._paused:
+                self._paused.discard(gk)
+                paused_gone.append(gk)
+        if paused_gone:
+            self.logger.delete_groups(paused_gone)
         metas_by_key = {m.gkey: m
                         for m in (self.table.by_name(n) for n in names)
                         if m is not None}  # dedupe repeated names
         metas = list(metas_by_key.values())
         if not metas:
-            return 0
+            return len(paused_gone)
         self.backend.delete(
             np.asarray([m.row for m in metas], np.int32))
         for meta in metas:
@@ -287,7 +314,118 @@ class PaxosNode:
         self.logger.delete_groups([m.gkey for m in metas])
         for meta in metas:
             self.app.restore(meta.name, b"")
-        return len(metas)
+        return len(metas) + len(paused_gone)
+
+    # ------------------------------------------------------------------
+    # pause / unpause (ref: DiskMap + HotRestoreInfo, SURVEY §5)
+    # ------------------------------------------------------------------
+
+    def _touch(self, row: int) -> None:
+        self._last_active[row] = time.time()
+
+    def _pause_rows(self, rows: List[int]) -> int:
+        """Serialize idle groups to the pause table and free their rows:
+        ONE device gather + ONE durable txn for the sweep.  A row is
+        skipped while anything is in flight for it locally."""
+        eligible = []
+        for row in rows:
+            meta = self.table.by_row(row)
+            if meta is None:
+                self._last_active.pop(row, None)
+                continue
+            if (row in self._elections or self._dec.get(row)
+                    or row in self._group_stopped):
+                self._touch(row)  # re-check later
+                continue
+            eligible.append((row, meta))
+        if not eligible:
+            return 0
+        snaps = self.backend.snapshot_rows([r for r, _ in eligible])
+        items = []
+        for (row, meta), snap in zip(eligible, snaps):
+            blob = json.dumps({
+                "name": meta.name,
+                "members": list(meta.members),
+                "version": meta.version,
+                "cursor": self._cursor.get(row, 0),
+                "bal_seen": self._bal_seen.get(row, NO_BALLOT),
+                "ckpt_slot": self._ckpt_slot.get(row, -1),
+                "app": base64.b64encode(
+                    self.app.checkpoint(meta.name)).decode(),
+                "snap": snap,
+            }, default=_np_jsonable).encode()
+            items.append((meta.gkey, blob))
+        self.logger.pause_many(items)
+        self.backend.delete(
+            np.asarray([r for r, _ in eligible], np.int32))
+        for row, meta in eligible:
+            self.table.delete(meta.gkey)
+            for d in (self._bal_seen, self._cursor, self._dec,
+                      self._ckpt_slot):
+                d.pop(row, None)
+            self._last_active.pop(row, None)
+            self._paused.add(meta.gkey)
+            # shed the app's resident state too — _maybe_unpause
+            # restores it from the blob
+            self.app.restore(meta.name, b"")
+        self.n_paused += len(eligible)
+        return len(eligible)
+
+    def _maybe_unpause(self, gkey: int):
+        """Hydrate a paused group on first touch; returns its GroupMeta
+        or None (ref: PaxosManager.getInstance unpause-on-access).  The
+        durable pause record is deleted only AFTER hydration succeeds —
+        a failure (e.g. capacity full) leaves the group cold but
+        reachable."""
+        if gkey not in self._paused:
+            return None
+        blob = self.logger.peek_pause(gkey)
+        if blob is None:
+            self._paused.discard(gkey)
+            return None
+        d = json.loads(blob)
+        meta = self.table.create(d["name"], tuple(d["members"]),
+                                 d["version"])
+        self.backend.restore_row(meta.row, d["snap"])
+        self._cursor[meta.row] = d["cursor"]
+        self._bal_seen[meta.row] = d["bal_seen"]
+        self._ckpt_slot[meta.row] = d["ckpt_slot"]
+        self._dec[meta.row] = {}
+        self.app.restore(d["name"], base64.b64decode(d["app"]))
+        self.logger.delete_pause(gkey)
+        self._paused.discard(gkey)
+        self._touch(meta.row)
+        self.n_unpaused += 1
+        # the coordinator may have died while this group was cold — the
+        # dead-node scan only covers hydrated rows, so re-check here
+        now = time.time()
+        _num, coord = unpack_ballot(self._bal_seen.get(meta.row,
+                                                       NO_BALLOT))
+        if coord >= 0 and coord != self.id and coord in self.addr_map:
+            last = self._last_heard.get(coord,
+                                        getattr(self, "_boot_ts", now))
+            if now - last > self.failure_timeout:
+                self._run_if_next_in_line(meta, coord, now)
+        return meta
+
+    def _lookup(self, gkey: int):
+        """by_key with unpause-on-demand."""
+        meta = self.table.by_key(gkey)
+        if meta is None:
+            meta = self._maybe_unpause(gkey)
+        return meta
+
+    def _rows_for_keys(self, gkeys: np.ndarray) -> np.ndarray:
+        """Batched gkey->row that hydrates paused groups on demand."""
+        rows = self.table.rows_for_keys(gkeys)
+        if self._paused and (rows < 0).any():
+            hit = False
+            for i in np.flatnonzero(rows < 0):
+                if self._maybe_unpause(int(gkeys[i])) is not None:
+                    hit = True
+            if hit:
+                rows = self.table.rows_for_keys(gkeys)
+        return rows
 
     # ------------------------------------------------------------------
     # intake
@@ -433,6 +571,18 @@ class PaxosNode:
                 if now - t > self.failure_timeout]
         for n in dead:
             self._on_node_dead(n)
+        # deactivator pass (ref: PaxosManager's pause thread); batched:
+        # one device gather + one pause txn per sweep
+        if self.pause_idle_s > 0:
+            cutoff = now - self.pause_idle_s
+            idle = []
+            for row, t in list(self._last_active.items()):
+                if t <= cutoff:
+                    idle.append(row)
+                    if len(idle) >= self.pause_max_per_tick:
+                        break
+            if idle:
+                self._pause_rows(idle)
         # GC the dedupe + response-cache + waiter tables (time TTL)
         if len(self._executed_recent) > 100000 or \
                 getattr(self, "_last_exec_gc", 0) + 30 < now:
@@ -468,12 +618,13 @@ class PaxosNode:
         for o in by_type.pop(pkt.CreateGroup, []):
             ok = self.create_group(o.name, o.members, o.version,
                                    o.initial_state)
-            existing = self.table.by_name(o.name)
+            gkey = pkt.group_key(o.name)
+            exists = (self.table.by_key(gkey) is not None
+                      or gkey in self._paused)  # paused groups exist
             self._route(o.sender, pkt.CreateGroupAck(
-                self.id, existing.gkey if existing else 0,
-                1 if (ok or existing is not None) else 0))
+                self.id, gkey, 1 if (ok or exists) else 0))
         for o in by_type.pop(pkt.DeleteGroup, []):
-            meta = self.table.by_key(o.gkey)
+            meta = self._lookup(o.gkey)
             if meta is not None:
                 self.delete_group(meta.name)
         for o in by_type.pop(pkt.FailureDetect, []):
@@ -491,7 +642,7 @@ class PaxosNode:
         for o in by_type.pop(pkt.SyncReply, []):
             self._handle_sync_reply(o)
         for o in by_type.pop(pkt.CheckpointRequest, []):
-            meta = self.table.by_key(o.gkey)
+            meta = self._lookup(o.gkey)
             if meta is not None:
                 self._route(o.sender, pkt.CheckpointReply(
                     self.id, meta.gkey,
@@ -549,7 +700,7 @@ class PaxosNode:
     def _handle_requests(self, reqs: List, props: List) -> None:
         lanes: List[Tuple[int, int, int, bytes, int]] = []  # row,req,fl,pl,en
         for o in reqs:
-            meta = self.table.by_key(o.gkey)
+            meta = self._lookup(o.gkey)
             if meta is None:
                 self._route(o.sender, pkt.Response(
                     self.id, o.gkey, o.req_id, 2, b""))
@@ -575,7 +726,7 @@ class PaxosNode:
                 continue
             lanes.append((meta.row, o.req_id, o.flags, o.payload, o.sender))
         for o in props:
-            meta = self.table.by_key(o.gkey)
+            meta = self._lookup(o.gkey)
             if meta is None:
                 continue
             if o.req_id in self._executed_recent:
@@ -603,6 +754,9 @@ class PaxosNode:
             return
         rows = np.asarray([l[0] for l in lanes], np.int32)
         req_ids = np.asarray([l[1] for l in lanes], np.uint64)
+        now = time.time()
+        for row in set(int(r) for r in rows):
+            self._last_active[row] = now
         res = self.backend.propose(rows, req_ids)
         for i, (row, req_id, flags, payload, entry) in enumerate(lanes):
             if res.granted[i]:
@@ -655,7 +809,7 @@ class PaxosNode:
                                     for o in objs])
         bals_all = np.concatenate([np.asarray(o.bal, np.int32)
                                    for o in objs])
-        rows_all = self.table.rows_for_keys(gkeys)
+        rows_all = self._rows_for_keys(gkeys)
         keep = native.coalesce_max(rows_all, slots_all, bals_all)
         if not keep.any():
             return
@@ -672,6 +826,9 @@ class PaxosNode:
         slots = slots_all[idxs]
         bals = bals_all[idxs]
         req_ids = np.asarray([lane_src[i][1] for i in idxs], np.uint64)
+        now = time.time()
+        for row in set(int(r) for r in rows):
+            self._last_active[row] = now
         res = self.backend.accept(rows, slots, bals, req_ids)
 
         entries = []
@@ -713,7 +870,7 @@ class PaxosNode:
     # -- accept replies (coordinator side) ------------------------------
 
     def _handle_accept_replies(self, objs: List) -> None:
-        all_rows = self.table.rows_for_keys(
+        all_rows = self._rows_for_keys(
             np.concatenate([np.asarray(o.gkey, np.uint64) for o in objs]))
         seen: Set[Tuple[int, int, int]] = set()
         rows_l, slots_l, bals_l, senders_l, acked_l = [], [], [], [], []
@@ -770,7 +927,7 @@ class PaxosNode:
     # -- commits → execution -------------------------------------------
 
     def _handle_commits(self, objs: List) -> None:
-        all_rows = self.table.rows_for_keys(
+        all_rows = self._rows_for_keys(
             np.concatenate([np.asarray(o.gkey, np.uint64) for o in objs]))
         ded: Dict[Tuple[int, int], int] = {}
         pos = 0
@@ -790,6 +947,9 @@ class PaxosNode:
         rows = np.asarray([k[0] for k in keys], np.int32)
         slots = np.asarray([k[1] for k in keys], np.int32)
         req_ids = np.asarray([ded[k] for k in keys], np.uint64)
+        now = time.time()
+        for row in set(int(r) for r in rows):
+            self._last_active[row] = now
         res = self.backend.commit(rows, slots, req_ids)
         self.logger.log_batch(
             [LogEntry(REC_DECIDE, self.table.by_row(k[0]).gkey, k[1], 0,
@@ -899,7 +1059,7 @@ class PaxosNode:
                                          cur + self.backend.window))
 
     def _handle_sync_request(self, o) -> None:
-        meta = self.table.by_key(o.gkey)
+        meta = self._lookup(o.gkey)
         if meta is None:
             return
         row = meta.row
@@ -989,26 +1149,29 @@ class PaxosNode:
         log.info("node %d: peer %d suspected dead", self.id, node)
         now = time.time()
         for meta in list(self.table):
-            row = meta.row
-            bal = self._bal_seen.get(row, NO_BALLOT)
-            num, coord = unpack_ballot(bal)
-            if coord != node or self.id not in meta.members:
+            self._run_if_next_in_line(meta, node, now)
+
+    def _run_if_next_in_line(self, meta, dead: int, now: float) -> None:
+        """If this row's believed coordinator is ``dead`` and self is the
+        first live member after it in ring order, run phase 1 (ref:
+        deterministic next-in-line from ballot/coordinator order)."""
+        row = meta.row
+        bal = self._bal_seen.get(row, NO_BALLOT)
+        _num, coord = unpack_ballot(bal)
+        if coord != dead or self.id not in meta.members:
+            return
+        order = list(meta.members)
+        start = (order.index(coord) + 1) % len(order)
+        nxt = None
+        for k in range(len(order)):
+            cand = order[(start + k) % len(order)]
+            if cand == dead:
                 continue
-            # next-in-line: first live member after the dead coordinator in
-            # ring order (ref: deterministic from ballot/coordinator order)
-            order = list(meta.members)
-            start = (order.index(coord) + 1) % len(order)
-            nxt = None
-            for k in range(len(order)):
-                cand = order[(start + k) % len(order)]
-                if cand == node:
-                    continue
-                if cand == self.id or now - self._last_heard.get(
-                        cand, 0) <= self.failure_timeout:
-                    nxt = cand
-                    break
-            if nxt != self.id:
-                continue
+            if cand == self.id or now - self._last_heard.get(
+                    cand, 0) <= self.failure_timeout:
+                nxt = cand
+                break
+        if nxt == self.id:
             self._start_election(row, meta)
 
     def _start_election(self, row: int, meta) -> None:
@@ -1025,7 +1188,7 @@ class PaxosNode:
         # coalesce to max ballot per row
         best: Dict[int, Tuple[int, int]] = {}
         for o in objs:
-            meta = self.table.by_key(o.gkey)
+            meta = self._lookup(o.gkey)
             if meta is None:
                 continue
             if meta.row not in best or o.bal > best[meta.row][0]:
@@ -1158,11 +1321,16 @@ class PaxosNode:
     # ------------------------------------------------------------------
 
     def _recover(self) -> None:
+        # paused groups stay cold: their rows hydrate on first touch
+        # (ref: lazy recovery at million-group scale, SURVEY §7.3.6)
+        self._paused = set(self.logger.paused_keys())
         groups = self.logger.all_groups()
         if not groups:
             return
         t0 = time.time()
         for gkey, name, version, members in groups:
+            if gkey in self._paused:
+                continue
             meta_exists = self.table.by_key(gkey)
             if meta_exists:
                 continue
@@ -1179,6 +1347,7 @@ class PaxosNode:
             self._cursor[meta.row] = 0
             self._dec[meta.row] = {}
             self._ckpt_slot[meta.row] = -1
+            self._last_active[meta.row] = t0  # pause-eligible when idle
             rec = self.logger.get_checkpoint(gkey)
             if rec is not None and rec.slot >= 0:
                 self.app.restore(name, rec.state)
@@ -1230,6 +1399,15 @@ class PaxosNode:
                 self._execute_row(r)
         log.info("node %d recovered %d groups in %.3fs", self.id,
                  len(groups), time.time() - t0)
+
+
+def _np_jsonable(o):
+    """json.dumps default= hook for numpy scalars/arrays in pause blobs."""
+    if isinstance(o, np.ndarray):
+        return o.tolist()
+    if isinstance(o, np.generic):
+        return o.item()
+    raise TypeError(f"not jsonable: {type(o)}")
 
 
 def _split_reqs(reqs: List[int]) -> Tuple[np.ndarray, np.ndarray]:

@@ -33,5 +33,12 @@ class PC(ConfigKey):
     # failure detection
     PING_INTERVAL_S = 0.5
     FAILURE_TIMEOUT_S = 3.0
+    # deactivator (ref: DiskMap pause/unpause — the million-idle-groups
+    # enabler): evict groups idle this long to the durable pause table,
+    # freeing their device row; 0 disables.  Unpause is on-demand when
+    # a packet arrives for a paused group.
+    PAUSE_IDLE_S = 60.0
+    # max groups paused per tick (bounds worker stall)
+    PAUSE_MAX_PER_TICK = 256
     # max requests outstanding per client connection before pushback
     CLIENT_MAX_OUTSTANDING = 8192

@@ -1,0 +1,170 @@
+"""Deactivator: idle groups pause to the durable pause table (freeing
+their device row) and hydrate on demand — the million-idle-groups memory
+story (ref: DiskMap + HotRestoreInfo + PaxosManager's pause thread,
+SURVEY.md §5)."""
+
+import time
+
+import pytest
+
+from gigapaxos_tpu.paxos.client import PaxosClient
+from gigapaxos_tpu.paxos.paxosconfig import PC
+from gigapaxos_tpu.utils.config import Config
+from tests.test_e2e import make_cluster, shutdown
+
+
+@pytest.mark.parametrize("backend", ["scalar", "columnar"])
+def test_pause_and_unpause_on_demand(tmp_path, backend):
+    Config.set(PC.PING_INTERVAL_S, 0.1)
+    Config.set(PC.PAUSE_IDLE_S, 0.5)
+    try:
+        nodes, addr_map = make_cluster(tmp_path, backend=backend)
+        try:
+            names = [f"pz{i}" for i in range(8)]
+            for nd in nodes:
+                nd.create_groups([(n, (0, 1, 2)) for n in names])
+            cli = PaxosClient([addr_map[i] for i in range(3)], timeout=10)
+            try:
+                for n in names:
+                    assert cli.send_request(n, b"one").status == 0
+                # go idle past the pause threshold
+                deadline = time.time() + 10
+                while time.time() < deadline:
+                    if all(nd.n_paused >= len(names) for nd in nodes):
+                        break
+                    time.sleep(0.1)
+                for nd in nodes:
+                    assert nd.n_paused >= len(names), \
+                        f"node {nd.id} paused only {nd.n_paused}"
+                    assert nd.table.by_name(names[0]) is None
+                    assert len(nd.table) == 0
+                # touch a paused group: transparent unpause, state intact
+                r = cli.send_request(names[0], b"two")
+                assert r.status == 0
+                deadline = time.time() + 10
+                while time.time() < deadline:
+                    if all(nd.app.count.get(names[0], 0) == 2
+                           for nd in nodes):
+                        break
+                    time.sleep(0.05)
+                counts = [nd.app.count.get(names[0]) for nd in nodes]
+                assert counts == [2, 2, 2], counts
+                digests = {nd.app.digest.get(names[0]) for nd in nodes}
+                assert len(digests) == 1
+                assert all(nd.n_unpaused >= 1 for nd in nodes)
+                # a never-touched paused group still answers after a
+                # create attempt is refused (it exists, just cold)
+                for nd in nodes:
+                    assert not nd.create_group(names[1], (0, 1, 2))
+                assert cli.send_request(names[1], b"two").status == 0
+            finally:
+                cli.close()
+        finally:
+            shutdown(nodes)
+    finally:
+        Config.set(PC.PAUSE_IDLE_S, 60.0)
+        Config.set(PC.PING_INTERVAL_S, 0.5)
+
+
+def test_pause_survives_restart(tmp_path):
+    """Paused groups stay cold across a restart and hydrate on first
+    touch (lazy recovery, SURVEY §7.3.6)."""
+    Config.set(PC.PING_INTERVAL_S, 0.1)
+    Config.set(PC.PAUSE_IDLE_S, 0.4)
+    try:
+        nodes, addr_map = make_cluster(tmp_path, backend="scalar")
+        try:
+            for nd in nodes:
+                nd.create_group("cold", (0, 1, 2))
+            cli = PaxosClient([addr_map[i] for i in range(3)],
+                              timeout=10)
+            try:
+                assert cli.send_request("cold", b"x").status == 0
+                deadline = time.time() + 10
+                while time.time() < deadline:
+                    if all(nd.n_paused >= 1 for nd in nodes):
+                        break
+                    time.sleep(0.1)
+                assert all(nd.n_paused >= 1 for nd in nodes)
+            finally:
+                cli.close()
+        finally:
+            shutdown(nodes)
+        # restart all nodes on the same logdirs/ports
+        from gigapaxos_tpu.paxos.interfaces import CounterApp
+        from gigapaxos_tpu.paxos.manager import PaxosNode
+        nodes2 = []
+        for i in range(3):
+            nd = PaxosNode(i, addr_map, CounterApp(),
+                           str(tmp_path / f"n{i}"), backend="scalar",
+                           capacity=1 << 10, window=16)
+            nd.start()
+            nodes2.append(nd)
+        try:
+            # cold after recovery: not in the table, but answers
+            assert all(nd.table.by_name("cold") is None for nd in nodes2)
+            cli = PaxosClient([addr_map[i] for i in range(3)],
+                              timeout=10)
+            try:
+                assert cli.send_request("cold", b"y").status == 0
+                deadline = time.time() + 10
+                while time.time() < deadline:
+                    if all(nd.app.count.get("cold", 0) == 2
+                           for nd in nodes2):
+                        break
+                    time.sleep(0.05)
+                assert [nd.app.count.get("cold") for nd in nodes2] == \
+                    [2, 2, 2]
+            finally:
+                cli.close()
+        finally:
+            shutdown(nodes2)
+    finally:
+        Config.set(PC.PAUSE_IDLE_S, 60.0)
+        Config.set(PC.PING_INTERVAL_S, 0.5)
+
+def test_unpause_after_coordinator_death_elects(tmp_path):
+    """Coordinator dies while the group is paused on survivors: the
+    first touch after hydration must trigger re-election, not forward
+    requests to the dead node forever."""
+    from gigapaxos_tpu.paxos.packets import group_key
+
+    Config.set(PC.PING_INTERVAL_S, 0.1)
+    Config.set(PC.FAILURE_TIMEOUT_S, 0.8)
+    Config.set(PC.PAUSE_IDLE_S, 0.4)
+    try:
+        nodes, addr_map = make_cluster(tmp_path, backend="scalar")
+        cli = None
+        try:
+            name = "pzfo"
+            for nd in nodes:
+                nd.create_group(name, (0, 1, 2))
+            dead = group_key(name) % 3
+            cli = PaxosClient(
+                [addr_map[i] for i in range(3) if i != dead], timeout=6)
+            assert cli.send_request(name, b"a").status == 0
+            # wait for the group to pause everywhere, then kill the coord
+            deadline = time.time() + 10
+            while time.time() < deadline:
+                if all(nd.n_paused >= 1 for nd in nodes):
+                    break
+                time.sleep(0.1)
+            time.sleep(0.3)  # survivors have last_heard for everyone
+            nodes[dead].stop(abort=True)
+            time.sleep(1.2)  # past failure timeout
+            ok = 0
+            for k in range(8):
+                try:
+                    ok += int(cli.send_request(
+                        name, f"b{k}".encode()).status == 0)
+                except TimeoutError:
+                    pass
+            assert ok >= 6, f"only {ok}/8 after unpause+failover"
+        finally:
+            if cli:
+                cli.close()
+            shutdown([nd for nd in nodes if not nd._stopping])
+    finally:
+        Config.set(PC.PAUSE_IDLE_S, 60.0)
+        Config.set(PC.PING_INTERVAL_S, 0.5)
+        Config.set(PC.FAILURE_TIMEOUT_S, 3.0)
