@@ -31,13 +31,18 @@ class PaxosClientAsync:
     """Asyncio client: ``await send_request(name_or_gkey, payload)``."""
 
     def __init__(self, client_id: int, servers: List[Tuple[str, int]],
-                 timeout: float = 5.0, retries: int = 3):
+                 timeout: float = 5.0, retries: int = 3,
+                 retransmit_s: float = 1.0):
         assert 0 < client_id < (1 << 31), \
             "client id must fit the transport's signed-32 handshake"
         self.id = client_id
         self.servers = list(servers)
-        self.timeout = timeout
+        self.timeout = timeout  # TOTAL budget per request
         self.retries = retries
+        # first retransmit after this long (doubling), NOT after the
+        # whole timeout — a request stuck behind a dead coordinator must
+        # re-route quickly (ref: client retransmit; dedup is server-side)
+        self.retransmit_s = retransmit_s
         self._seq = itertools.count(1)
         self._conns: Dict[int, Tuple[asyncio.StreamReader,
                                      asyncio.StreamWriter]] = {}
@@ -93,7 +98,16 @@ class PaxosClientAsync:
         gkey = pkt.group_key(name)
         req_id = self.next_req_id()
         last_exc: Optional[Exception] = None
-        for attempt in range(self.retries + 1):
+        deadline = asyncio.get_running_loop().time() + self.timeout
+        attempt = 0
+        while attempt <= self.retries:
+            remaining = deadline - asyncio.get_running_loop().time()
+            if remaining <= 0:
+                break
+            # escalate the retransmit interval; the LAST attempt gets
+            # whatever budget is left
+            wait = remaining if attempt == self.retries else min(
+                self.retransmit_s * (1 << min(attempt, 4)), remaining)
             idx = (self._preferred + attempt) % len(self.servers)
             try:
                 _, writer = await self._conn(idx)
@@ -103,15 +117,20 @@ class PaxosClientAsync:
                                     payload).encode()
                 writer.write(_LEN.pack(len(frame)) + frame)
                 await writer.drain()
-                resp = await asyncio.wait_for(fut, self.timeout)
+                resp = await asyncio.wait_for(fut, wait)
                 if resp.status == 0:
                     self._preferred = idx
                     return resp
                 last_exc = RuntimeError(f"status={resp.status}")
+                # non-ok statuses are immediate (no wait): back off a
+                # beat so a re-electing group isn't hammered
+                await asyncio.sleep(
+                    min(0.05 * (1 << min(attempt, 4)), remaining))
             except (asyncio.TimeoutError, ConnectionError, OSError) as e:
                 last_exc = e
             finally:
                 self._waiting.pop(req_id, None)
+            attempt += 1
         raise TimeoutError(
             f"request {req_id:#x} to {name!r} failed: {last_exc}")
 

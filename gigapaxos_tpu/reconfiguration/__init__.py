@@ -12,6 +12,9 @@ from gigapaxos_tpu.reconfiguration.appclient import ReconfigurableAppClient
 from gigapaxos_tpu.reconfiguration.consistenthash import ConsistentHashing
 from gigapaxos_tpu.reconfiguration.coordinator import (
     AbstractReplicaCoordinator, PaxosReplicaCoordinator)
+from gigapaxos_tpu.reconfiguration.demand import (
+    AbstractDemandProfile, LoadBalancingDemandProfile,
+    LocalityDemandProfile)
 from gigapaxos_tpu.reconfiguration.node import ReconfigurableNode
 from gigapaxos_tpu.reconfiguration.rcdb import RCRecord, ReconfiguratorDB
 from gigapaxos_tpu.reconfiguration.reconfigurator import Reconfigurator
@@ -19,5 +22,7 @@ from gigapaxos_tpu.reconfiguration.reconfigurator import Reconfigurator
 __all__ = [
     "ActiveReplica", "ReconfigurableAppClient", "ConsistentHashing",
     "AbstractReplicaCoordinator", "PaxosReplicaCoordinator",
+    "AbstractDemandProfile", "LoadBalancingDemandProfile",
+    "LocalityDemandProfile",
     "ReconfigurableNode", "RCRecord", "ReconfiguratorDB", "Reconfigurator",
 ]

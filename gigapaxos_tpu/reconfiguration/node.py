@@ -67,6 +67,7 @@ class ReconfigurableNode:
 
     def __init__(self, node_id: int, config: NodeConfig,
                  app_factory: Callable[[], Replicable], logdir: str,
+                 demand_policy=None, demand_report_every: int = 100,
                  **node_kw):
         self.id = node_id
         self.config = config
@@ -77,14 +78,15 @@ class ReconfigurableNode:
             self.active = ActiveReplica(
                 node_id, amap, tuple(config.reconfigurators),
                 app_factory(), os.path.join(logdir, f"ar{node_id}"),
-                **node_kw)
+                demand_report_every=demand_report_every, **node_kw)
         if node_id in config.reconfigurators:
             self.reconfigurator = Reconfigurator(
                 node_id, amap, tuple(config.reconfigurators),
                 tuple(config.actives),
                 os.path.join(logdir, f"rc{node_id}"),
                 actives_per_name=config.actives_per_name,
-                rc_group_size=config.rc_group_size, **node_kw)
+                rc_group_size=config.rc_group_size,
+                demand_policy=demand_policy, **node_kw)
         if self.active is None and self.reconfigurator is None:
             raise ValueError(f"node {node_id} has no role in the config")
 

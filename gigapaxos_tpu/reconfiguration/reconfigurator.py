@@ -31,6 +31,7 @@ from gigapaxos_tpu.paxos import packets as pkt
 from gigapaxos_tpu.paxos.manager import PaxosNode
 from gigapaxos_tpu.reconfiguration import rcpackets as rc
 from gigapaxos_tpu.reconfiguration.consistenthash import ConsistentHashing
+from gigapaxos_tpu.reconfiguration.demand import AbstractDemandProfile
 from gigapaxos_tpu.reconfiguration.rcdb import (READY, WAIT_ACK_START,
                                                 WAIT_ACK_STOP, RCRecord,
                                                 ReconfiguratorDB)
@@ -248,23 +249,39 @@ class Reconfigurator:
     def _on_demand(self, sender: int, b: dict) -> None:
         if self.demand_policy is None:
             return
+        profile = self.demand_policy \
+            if isinstance(self.demand_policy, AbstractDemandProfile) \
+            else None
         for name, cnt in b.get("reports", {}).items():
             grp = self.group_of(name)
             if self.id not in self.group_members(grp):
                 # not our record: forward the report to the owning group
                 # (actives report by active id, not by record owner)
                 self.node._route(self._live_member(grp), pkt.Control(
-                    self.id, rc.demand({name: int(cnt)})))
+                    sender, rc.demand({name: int(cnt)})))
                 continue
-            total = self._demand.get(name, 0) + int(cnt)
-            self._demand[name] = total
             rec = self.db.lookup(grp, name)
-            if rec is None or rec.state != READY:
-                continue
-            new = self.demand_policy(name, total, list(rec.actives),
-                                     list(self.actives))
+            if profile is not None:
+                # profile SPI (ref: AbstractDemandProfile.register +
+                # shouldReconfigure): per-reporter aggregation
+                profile.register(name, sender, int(cnt))
+                if rec is None or rec.state != READY:
+                    continue
+                new = profile.should_reconfigure(
+                    name, list(rec.actives), list(self.actives))
+            else:
+                # legacy callable SPI: (name, total, current, all)
+                total = self._demand.get(name, 0) + int(cnt)
+                self._demand[name] = total
+                if rec is None or rec.state != READY:
+                    continue
+                new = self.demand_policy(name, total, list(rec.actives),
+                                         list(self.actives))
             if new and sorted(new) != sorted(rec.actives):
-                self._demand[name] = 0
+                if profile is not None:
+                    profile.on_moved(name)
+                else:
+                    self._demand[name] = 0
                 self._propose(grp, {"op": "move", "name": name,
                                     "new_actives": list(new)})
 

@@ -251,3 +251,78 @@ def test_concurrent_create_then_immediate_delete(tmp_path):
         run(body())
     finally:
         shutdown(nodes)
+
+
+def test_locality_demand_profile_unit():
+    from gigapaxos_tpu.reconfiguration.demand import LocalityDemandProfile
+
+    p = LocalityDemandProfile(threshold=10)
+    for _ in range(4):
+        p.register("svc", 3, 2)  # active 3: 8 total
+    p.register("svc", 1, 1)
+    assert p.should_reconfigure("svc", [0, 1, 2], [0, 1, 2, 3]) is None
+    p.register("svc", 3, 2)  # total 11 >= threshold
+    new = p.should_reconfigure("svc", [0, 1, 2], [0, 1, 2, 3])
+    # top reporter 3 enters; fill from current keeps size 3
+    assert new is not None and 3 in new and len(new) == 3
+    # after a clear, aggregates reset
+    p.clear("svc")
+    assert p.should_reconfigure("svc", [0, 1, 2], [0, 1, 2, 3]) is None
+    # demand matching placement proposes nothing (and resets)
+    for _ in range(11):
+        p.register("svc2", 0, 1)
+    assert p.should_reconfigure("svc2", [0, 1], [0, 1, 2]) is None
+
+
+def test_demand_driven_move(tmp_path):
+    """Replicas follow demand: with a LocalityDemandProfile, a name served
+    from active 3 (not in its replica set) migrates onto it (ref:
+    DemandProfile -> DemandReport -> Reconfigurator move)."""
+    from gigapaxos_tpu.reconfiguration.demand import \
+        LoadBalancingDemandProfile
+
+    Config.set(PC.SYNC_WAL, False)
+    Config.set(PC.PING_INTERVAL_S, 0.05)
+    ports = free_ports(5)
+    cfg = NodeConfig(
+        actives={i: ("127.0.0.1", ports[i]) for i in range(4)},
+        reconfigurators={100: ("127.0.0.1", ports[4])},
+        actives_per_name=3, rc_group_size=1)
+    nodes = [ReconfigurableNode(
+        i, cfg, KVApp, str(tmp_path),
+        demand_policy=LoadBalancingDemandProfile(threshold=30),
+        demand_report_every=10, capacity=1 << 10, window=16)
+        for i in list(cfg.actives) + list(cfg.reconfigurators)]
+    for nd in nodes:
+        nd.start()
+    try:
+        async def body():
+            cli = ReconfigurableAppClient(1 << 16, cfg, timeout=15)
+            try:
+                assert await cli.create("hotname", b"")
+                before = sorted(await cli.get_actives("hotname"))
+                # hammer through requests; entry active reports demand
+                for k in range(60):
+                    await cli.send_request(
+                        "hotname",
+                        f'{{"op":"put","k":"x","v":"{k}"}}'.encode())
+                # wait for a demand-driven move to commit
+                deadline = time.time() + 20
+                moved = False
+                while time.time() < deadline:
+                    cli._actives_cache.pop("hotname", None)
+                    now_actives = sorted(await cli.get_actives("hotname"))
+                    if now_actives != before:
+                        moved = True
+                        break
+                    await asyncio.sleep(0.3)
+                assert moved, f"never moved off {before}"
+                # still serves requests after the move
+                r = await cli.send_request(
+                    "hotname", b'{"op":"get","k":"x"}')
+                assert b'"59"' in r
+            finally:
+                await cli.close()
+        run(body())
+    finally:
+        shutdown(nodes)
