@@ -1,0 +1,190 @@
+"""HTTP front-end for name operations and app requests.
+
+Reference analog: ``reconfiguration/http/HttpReconfigurator.java`` +
+``http/HttpActiveReplica.java`` (Netty-based HTTP API).  Here: a
+dependency-free asyncio HTTP/1.1 gateway wrapping
+:class:`ReconfigurableAppClient`, deployable next to any node (or
+standalone) so curl/browser clients can drive the cluster without the
+binary wire protocol.
+
+Routes::
+
+    POST /create        {"name": ..., "initial_state": ...?}  -> {"ok"...}
+    POST /delete        {"name": ...}                         -> {"ok"...}
+    GET  /actives/NAME                                        -> {"actives"}
+    POST /request/NAME  raw body = app payload     -> raw app response
+    GET  /healthz                                             -> ok
+
+Run standalone::
+
+    python -m gigapaxos_tpu.reconfiguration.http \
+        --config conf/gigapaxos.properties --port 8080
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+from typing import Optional, Tuple
+
+from gigapaxos_tpu.reconfiguration.appclient import ReconfigurableAppClient
+from gigapaxos_tpu.reconfiguration.node import NodeConfig
+from gigapaxos_tpu.utils.logutil import get_logger
+
+log = get_logger("gp.http")
+
+MAX_BODY = 8 * 1024 * 1024
+
+
+class HttpFrontend:
+    """Minimal HTTP/1.1 server bridging to the cluster."""
+
+    def __init__(self, config: NodeConfig, listen: Tuple[str, int],
+                 client_id: int = (1 << 21) + 7, timeout: float = 10.0):
+        self.config = config
+        self.listen = listen
+        self.cli = ReconfigurableAppClient(client_id, config,
+                                           timeout=timeout)
+        self._server: Optional[asyncio.base_events.Server] = None
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(
+            self._handle, self.listen[0], self.listen[1])
+
+    @property
+    def port(self) -> int:
+        return self._server.sockets[0].getsockname()[1]
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+        await self.cli.close()
+
+    # -- request handling --------------------------------------------------
+
+    async def _handle(self, reader: asyncio.StreamReader,
+                      writer: asyncio.StreamWriter) -> None:
+        try:
+            while True:
+                line = await reader.readline()
+                if not line or line in (b"\r\n", b"\n"):
+                    return
+                try:
+                    method, path, _ver = line.decode().split(None, 2)
+                except ValueError:
+                    return
+                clen = 0
+                keep = True
+                while True:
+                    h = await reader.readline()
+                    if h in (b"\r\n", b"\n", b""):
+                        break
+                    k, _, v = h.decode().partition(":")
+                    k = k.strip().lower()
+                    if k == "content-length":
+                        clen = min(int(v.strip()), MAX_BODY)
+                    elif k == "connection" and \
+                            v.strip().lower() == "close":
+                        keep = False
+                body = await reader.readexactly(clen) if clen else b""
+                status, ctype, out = await self._route(method, path, body)
+                writer.write(
+                    f"HTTP/1.1 {status}\r\n"
+                    f"Content-Type: {ctype}\r\n"
+                    f"Content-Length: {len(out)}\r\n"
+                    f"Connection: {'keep-alive' if keep else 'close'}"
+                    f"\r\n\r\n".encode() + out)
+                await writer.drain()
+                if not keep:
+                    return
+        except (asyncio.IncompleteReadError, ConnectionError, OSError):
+            pass
+        finally:
+            writer.close()
+
+    async def _route(self, method: str, path: str, body: bytes
+                     ) -> Tuple[str, str, bytes]:
+        try:
+            if method == "GET" and path == "/healthz":
+                return "200 OK", "text/plain", b"ok\n"
+            if method == "GET" and path.startswith("/actives/"):
+                name = path[len("/actives/"):]
+                try:
+                    actives = await self.cli.get_actives(name)
+                except KeyError:
+                    return ("404 Not Found", "application/json",
+                            b'{"err":"nonexistent"}')
+                return ("200 OK", "application/json",
+                        json.dumps({"actives": actives}).encode())
+            if method == "POST" and path == "/create":
+                d = json.loads(body.decode() or "{}")
+                if not isinstance(d, dict) or "name" not in d:
+                    return ("400 Bad Request", "application/json",
+                            b'{"err":"name required"}')
+                ok = await self.cli.create(
+                    d["name"],
+                    str(d.get("initial_state", "")).encode())
+                return ("200 OK", "application/json",
+                        json.dumps({"ok": bool(ok)}).encode())
+            if method == "POST" and path == "/delete":
+                d = json.loads(body.decode() or "{}")
+                if not isinstance(d, dict) or "name" not in d:
+                    return ("400 Bad Request", "application/json",
+                            b'{"err":"name required"}')
+                ok = await self.cli.delete(d["name"])
+                return ("200 OK", "application/json",
+                        json.dumps({"ok": bool(ok)}).encode())
+            if method == "POST" and path.startswith("/request/"):
+                name = path[len("/request/"):]
+                try:
+                    resp = await self.cli.send_request(name, body)
+                except KeyError:
+                    return ("404 Not Found", "application/json",
+                            b'{"err":"nonexistent"}')
+                return "200 OK", "application/octet-stream", resp
+            return "404 Not Found", "text/plain", b"no such route\n"
+        except (ValueError, UnicodeDecodeError):
+            return ("400 Bad Request", "application/json",
+                    b'{"err":"bad request"}')
+        except TimeoutError as e:
+            return ("504 Gateway Timeout", "application/json",
+                    json.dumps({"err": str(e)}).encode())
+        except Exception:
+            log.exception("http route %s %s failed", method, path)
+            return ("500 Internal Server Error", "application/json",
+                    b'{"err":"internal"}')
+
+
+def main(argv=None) -> int:
+    import argparse
+    import sys
+
+    p = argparse.ArgumentParser(
+        prog="gigapaxos_tpu.reconfiguration.http",
+        description="HTTP gateway to a gigapaxos-tpu cluster")
+    p.add_argument("--config", required=True)
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=8080)
+    args = p.parse_args(argv)
+    config = NodeConfig.from_properties(args.config)
+
+    async def run():
+        fe = HttpFrontend(config, (args.host, args.port))
+        await fe.start()
+        log.info("http front-end on %s:%d", args.host, fe.port)
+        try:
+            await asyncio.Event().wait()
+        finally:
+            await fe.stop()
+
+    try:
+        asyncio.run(run())
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

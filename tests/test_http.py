@@ -1,0 +1,66 @@
+"""HTTP front-end against a live in-process cluster (ref:
+HttpReconfigurator/HttpActiveReplica)."""
+
+import asyncio
+import json
+import urllib.error
+import urllib.request
+
+from gigapaxos_tpu.reconfiguration.http import HttpFrontend
+from tests.test_reconfiguration import make_cluster, shutdown
+
+
+def _req(url, data=None, method=None):
+    r = urllib.request.Request(url, data=data, method=method)
+    with urllib.request.urlopen(r, timeout=20) as resp:
+        return resp.status, resp.read()
+
+
+def test_http_lifecycle(tmp_path):
+    nodes, cfg = make_cluster(tmp_path)
+    try:
+        async def body():
+            fe = HttpFrontend(cfg, ("127.0.0.1", 0), timeout=15)
+            await fe.start()
+            base = f"http://127.0.0.1:{fe.port}"
+            loop = asyncio.get_running_loop()
+
+            def call(*a, **k):
+                return loop.run_in_executor(None, lambda: _req(*a, **k))
+
+            try:
+                st, out = await call(f"{base}/healthz")
+                assert st == 200 and out == b"ok\n"
+                st, out = await call(
+                    f"{base}/create",
+                    json.dumps({"name": "web1"}).encode())
+                assert st == 200 and json.loads(out)["ok"]
+                st, out = await call(f"{base}/actives/web1")
+                assert st == 200 and len(json.loads(out)["actives"]) == 3
+                st, out = await call(
+                    f"{base}/request/web1",
+                    b'{"op":"put","k":"a","v":"b"}')
+                assert st == 200 and b"ok" in out
+                st, out = await call(
+                    f"{base}/request/web1", b'{"op":"get","k":"a"}')
+                assert st == 200 and b'"b"' in out
+                st, out = await call(
+                    f"{base}/delete",
+                    json.dumps({"name": "web1"}).encode())
+                assert st == 200 and json.loads(out)["ok"]
+                try:
+                    st, out = await call(f"{base}/actives/web1")
+                    assert False, f"expected 404, got {st} {out!r}"
+                except urllib.error.HTTPError as e:
+                    assert e.code == 404
+                # bad request shapes
+                try:
+                    await call(f"{base}/create", b"[]")
+                    assert False, "expected 400"
+                except urllib.error.HTTPError as e:
+                    assert e.code == 400
+            finally:
+                await fe.stop()
+        asyncio.run(body())
+    finally:
+        shutdown(nodes)
