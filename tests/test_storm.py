@@ -81,9 +81,9 @@ def test_graft_entry_single_chip():
 
 
 def test_graft_dryrun_multichip():
-    import jax
-    if len(jax.devices()) < 8:
-        pytest.skip("needs 8 virtual devices")
+    # No skip: dryrun_multichip self-provisions a virtual 8-device CPU
+    # platform in a subprocess when this process has fewer devices, which
+    # is exactly what the driver's external MULTICHIP check relies on.
     import sys
     sys.path.insert(0, "/root/repo")
     import __graft_entry__ as ge
