@@ -121,6 +121,13 @@ class PaxosClientAsync:
                 if resp.status == 0:
                     self._preferred = idx
                     return resp
+                if resp.status == 4:
+                    # deterministic app failure: the op was decided and
+                    # its execution failed identically on every replica —
+                    # retrying cannot succeed (servers answer retransmits
+                    # from the response cache), so surface it
+                    self._preferred = idx
+                    return resp
                 last_exc = RuntimeError(f"status={resp.status}")
                 # non-ok statuses are immediate (no wait): back off a
                 # beat so a re-electing group isn't hammered

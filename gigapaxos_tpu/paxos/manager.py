@@ -108,9 +108,19 @@ class PaxosNode:
         # req_id -> (flags, payload); GC'd at local execution (§7.3.5)
         self._payloads: Dict[int, Tuple[int, bytes]] = {}
         # entry-replica reply table: req_id -> client node id
-        self._client_wait: Dict[int, int] = {}
-        # coordinator dedupe: req_id -> True while in flight
-        self._proposed: Set[int] = set()
+        # req_id -> (client/entry id, enqueue ts, gkey): clients waiting
+        # on us as their entry replica for a not-yet-executed request
+        self._client_wait: Dict[int, Tuple[int, float, int]] = {}
+        # coordinator dedupe: req_id -> (row, proposed-at) while the
+        # proposal is in flight.  The row lets a group delete purge its
+        # in-flight entries — otherwise a request proposed in a deleted
+        # epoch is blackholed at this node forever (every retransmit into
+        # the successor epoch hits the dedupe and is dropped).  The
+        # timestamp lets the periodic GC reap entries whose decision
+        # never landed (e.g. preempted accept, client gave up), which
+        # would otherwise dedupe the req_id and pin the row unpausable
+        # for the life of the process.
+        self._proposed: Dict[int, Tuple[int, float]] = {}
         # rows whose epoch-stop request has executed: the RSM is closed —
         # later decided slots are skipped and clients told to re-resolve
         # (ref: PaxosInstanceStateMachine stopped/final-state logic)
@@ -119,9 +129,11 @@ class PaxosNode:
         # for client retransmits that cross a coordinator change (ref:
         # GCConcurrentHashMap outstanding-request tables, time-GC'd)
         self._executed_recent: Dict[int, float] = {}
-        # req_id -> response bytes for executed requests: a deduped
-        # retransmit is ANSWERED from here, never silently dropped
-        self._resp_cache: Dict[int, bytes] = {}
+        # req_id -> (status, response bytes) for executed requests: a
+        # deduped retransmit is ANSWERED from here, never silently
+        # dropped; status-4 (deterministic app failure) entries keep a
+        # retried failed request from re-executing in a new slot
+        self._resp_cache: Dict[int, Tuple[int, bytes]] = {}
         self._elections: Dict[int, _Election] = {}
 
         # deactivator (ref: DiskMap pause/unpause + HotRestoreInfo):
@@ -314,6 +326,24 @@ class PaxosNode:
         self.logger.delete_groups([m.gkey for m in metas])
         for meta in metas:
             self.app.restore(meta.name, b"")
+        # Purge coordinator dedupe entries for the deleted rows: a
+        # request proposed-but-undecided in a dying epoch must be
+        # re-proposable when its retransmit arrives in the successor
+        # epoch (same gkey, new instance) — stale entries blackhole it.
+        dead_rows = {m.row for m in metas}
+        for rid in [r for r, rw in self._proposed.items()
+                    if rw[0] in dead_rows]:
+            self._proposed.pop(rid, None)
+        # Answer clients still waiting on an in-flight (undecided)
+        # request for a deleted group: the delete is the cutoff — without
+        # this they silently wait out their whole timeout.  Status 3
+        # ("epoch stopped") makes a reconfiguration-aware client refresh
+        # its actives and retry on the new epoch's replicas.
+        gone = set(metas_by_key) | set(paused_gone)
+        for rid, w in list(self._client_wait.items()):
+            if len(w) > 2 and w[2] in gone:
+                self._client_wait.pop(rid, None)
+                self._route(w[0], pkt.Response(self.id, w[2], rid, 3, b""))
         return len(metas) + len(paused_gone)
 
     # ------------------------------------------------------------------
@@ -328,13 +358,17 @@ class PaxosNode:
         ONE device gather + ONE durable txn for the sweep.  A row is
         skipped while anything is in flight for it locally."""
         eligible = []
+        inflight_rows = {rw[0] for rw in self._proposed.values()}
         for row in rows:
             meta = self.table.by_row(row)
             if meta is None:
                 self._last_active.pop(row, None)
                 continue
             if (row in self._elections or self._dec.get(row)
-                    or row in self._group_stopped):
+                    or row in self._group_stopped
+                    or row in inflight_rows):
+                # in-flight proposals pin the row: pausing it would orphan
+                # coordinator-dedupe entries across a row reuse
                 self._touch(row)  # re-check later
                 continue
             eligible.append((row, meta))
@@ -384,8 +418,24 @@ class PaxosNode:
             self._paused.discard(gkey)
             return None
         d = json.loads(blob)
-        meta = self.table.create(d["name"], tuple(d["members"]),
-                                 d["version"])
+        try:
+            meta = self.table.create(d["name"], tuple(d["members"]),
+                                     d["version"])
+        except (MemoryError, ValueError):
+            # Capacity exhausted: leave the group cold-but-reachable and
+            # fail only this lookup — propagating would drop the whole
+            # worker batch (every unrelated packet in it) on each touch of
+            # the paused group.  Nudge the deactivator so a sweep can free
+            # rows before the client's retransmit lands.
+            log.warning("unpause of %r deferred: row capacity exhausted",
+                        d["name"])
+            if self.pause_idle_s > 0:
+                cutoff = time.time() - self.pause_idle_s
+                idle = [r for r, t in list(self._last_active.items())
+                        if t < cutoff][:self.pause_max_per_tick]
+                if idle:
+                    self._pause_rows(idle)
+            return None
         self.backend.restore_row(meta.row, d["snap"])
         self._cursor[meta.row] = d["cursor"]
         self._bal_seen[meta.row] = d["bal_seen"]
@@ -596,6 +646,13 @@ class PaxosNode:
             self._client_wait = {
                 r: w for r, w in self._client_wait.items()
                 if w[1] > now - 120}
+            # reap in-flight proposals whose decision never landed
+            # (preempted accept, client gave up): past any client's
+            # retransmit horizon a fresh proposal is the correct answer,
+            # and a stale entry would pin its row unpausable forever
+            self._proposed = {
+                r: rw for r, rw in self._proposed.items()
+                if rw[1] > now - 120}
 
     # -- batch processing ----------------------------------------------
 
@@ -708,15 +765,15 @@ class PaxosNode:
             if o.req_id in self._executed_recent:
                 # retransmit of an executed request: answer from the
                 # response cache, never drop silently (at-most-once + reply)
+                st, rv = self._resp_cache.get(o.req_id, (0, b""))
                 self._route(o.sender, pkt.Response(
-                    self.id, o.gkey, o.req_id, 0,
-                    self._resp_cache.get(o.req_id, b"")))
+                    self.id, o.gkey, o.req_id, st, rv))
                 continue
             if meta.row in self._group_stopped:
                 self._route(o.sender, pkt.Response(
                     self.id, o.gkey, o.req_id, 3, b""))
                 continue
-            self._client_wait[o.req_id] = (o.sender, time.time())
+            self._client_wait[o.req_id] = (o.sender, time.time(), o.gkey)
             coord = unpack_ballot(self._bal_seen[meta.row])[1]
             if coord != self.id:
                 self._route(coord, pkt.Proposal(
@@ -728,13 +785,20 @@ class PaxosNode:
         for o in props:
             meta = self._lookup(o.gkey)
             if meta is None:
+                # The group is gone here (deleted, or moved to a new
+                # epoch hosted elsewhere): a silent drop would leave the
+                # entry replica's client waiting out its whole timeout —
+                # answer "no such group" so the entry relays it and the
+                # client refreshes its actives and re-routes.
+                self._route(o.sender, pkt.Response(
+                    self.id, o.gkey, o.req_id, 2, b""))
                 continue
             if o.req_id in self._executed_recent:
                 # answer rides a Response to the entry replica, which
                 # relays it to the waiting client (see Response handler)
+                st, rv = self._resp_cache.get(o.req_id, (0, b""))
                 self._route(o.sender, pkt.Response(
-                    self.id, o.gkey, o.req_id, 0,
-                    self._resp_cache.get(o.req_id, b"")))
+                    self.id, o.gkey, o.req_id, st, rv))
                 continue
             if meta.row in self._group_stopped:
                 self._route(o.sender, pkt.Response(
@@ -760,7 +824,7 @@ class PaxosNode:
         res = self.backend.propose(rows, req_ids)
         for i, (row, req_id, flags, payload, entry) in enumerate(lanes):
             if res.granted[i]:
-                self._proposed.add(req_id)
+                self._proposed[req_id] = (row, now)
                 self._store_payload(req_id, flags, payload)
             elif res.rejected[i]:
                 # we believed we coordinate this group but the device
@@ -992,29 +1056,46 @@ class PaxosNode:
                 # the group and retry (ref: stopped-instance handling)
                 resp, status = b"", 3
             else:
-                try:
-                    resp = self.app.execute(meta.name, req_id, payload,
-                                            bool(flags & FLAG_STOP))
-                except Exception:
-                    # an app exception is deterministic (same payload on
-                    # every replica): answer with an error and ADVANCE —
-                    # leaving the slot unexecuted would wedge the group
-                    # on all replicas forever
-                    log.exception("app.execute failed for %s slot %d",
-                                  meta.name, cur)
+                # Bounded retries before declaring the exception
+                # deterministic: a transient, replica-local failure (I/O,
+                # resource limit) must not diverge replicated state — one
+                # replica applying the op while another records an error
+                # would fork the RSM (ref: the upstream retries
+                # app.execute to keep replicas in lockstep).  Only a
+                # repeatable failure is answered with status 4, and it
+                # still ADVANCES — leaving the slot unexecuted would
+                # wedge the group on every replica forever.
+                for attempt, backoff in enumerate((0.02, 0.2, 0.0)):
+                    try:
+                        resp = self.app.execute(meta.name, req_id, payload,
+                                                bool(flags & FLAG_STOP))
+                        break
+                    except Exception:
+                        log.exception(
+                            "app.execute failed for %s slot %d (try %d/3)",
+                            meta.name, cur, attempt + 1)
+                        # brief growing backoff so a sub-second transient
+                        # (fd/disk pressure) isn't misread as
+                        # deterministic on just this replica
+                        if backoff:
+                            time.sleep(backoff)
+                else:
                     resp, status = b'{"err":"app exception"}', 4
                 if flags & FLAG_STOP:
                     self._group_stopped.add(row)
             self.n_executed += 1
-            self._proposed.discard(req_id)
-            if status == 0:
-                # only APPLIED requests enter the at-most-once dedup
-                # tables; a stop-skipped request (status 3) must stay
+            self._proposed.pop(req_id, None)
+            if status in (0, 4):
+                # APPLIED requests and deterministic app failures both
+                # enter the at-most-once dedup tables: a retransmit of a
+                # failed request must be answered (with its status-4
+                # error) rather than re-proposed and re-executed in a new
+                # slot.  A stop-skipped request (status 3) must stay
                 # retryable in the next epoch — caching it would answer a
-                # retransmit with status 0 and an empty payload, i.e. a
-                # silently "successful" lost write
+                # retransmit with an empty "success", i.e. a silently
+                # lost write.
                 self._executed_recent[req_id] = time.time()
-                self._resp_cache[req_id] = resp
+                self._resp_cache[req_id] = (status, resp)
             waiter = self._client_wait.pop(req_id, None)
             if waiter is not None:
                 self._route(waiter[0], pkt.Response(

@@ -244,7 +244,11 @@ class Response:
     sender: int
     gkey: int
     req_id: int
-    status: int        # 0 ok; 1 not-coordinator/retry; 2 no-such-group
+    # 0 ok; 1 not-coordinator/retry; 2 no-such-group; 3 epoch-stopped
+    # (decided after the group's stop slot — re-resolve and retry);
+    # 4 deterministic app exception (decided + advanced; retrying the
+    # same request returns this same cached error)
+    status: int
     payload: bytes
 
     TYPE = PacketType.RESPONSE

@@ -27,6 +27,16 @@ _LEN = struct.Struct("<I")
 CLIENT_ID_BASE = 1 << 16  # below this: server node ids (id spaces disjoint)
 
 
+class AppError(RuntimeError):
+    """The request was decided and its execution failed deterministically
+    on every replica (Response status 4).  Retrying cannot succeed — the
+    servers answer retransmits with this same cached error."""
+
+    def __init__(self, payload: bytes):
+        super().__init__(payload.decode("utf-8", "replace"))
+        self.payload = payload
+
+
 class ReconfigurableAppClient:
     """``await`` API: create/delete/actives/move + send_request."""
 
@@ -171,6 +181,10 @@ class ReconfigurableAppClient:
                 if resp.status == 0:
                     self._preferred[name] = dst
                     return resp.payload
+                if resp.status == 4:
+                    # deterministic app failure: terminal (see AppError)
+                    self._preferred[name] = dst
+                    raise AppError(resp.payload)
                 if resp.status in (2, 3):
                     # 2: replica no longer hosts the group; 3: the group's
                     # epoch stopped under us (reconfiguration in flight) —

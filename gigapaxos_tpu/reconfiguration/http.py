@@ -83,10 +83,44 @@ class HttpFrontend:
                     k, _, v = h.decode().partition(":")
                     k = k.strip().lower()
                     if k == "content-length":
-                        clen = min(int(v.strip()), MAX_BODY)
+                        try:
+                            clen = int(v.strip())
+                        except ValueError:
+                            clen = -1
                     elif k == "connection" and \
                             v.strip().lower() == "close":
                         keep = False
+                if clen < 0:
+                    # malformed / negative Content-Length: a clean 400
+                    # beats an unhandled-exception connection kill
+                    out = b'{"err":"bad content-length"}'
+                    writer.write(
+                        f"HTTP/1.1 400 Bad Request\r\n"
+                        f"Content-Type: application/json\r\n"
+                        f"Content-Length: {len(out)}\r\n"
+                        f"Connection: close\r\n\r\n".encode() + out)
+                    await writer.drain()
+                    return
+                if clen > MAX_BODY:
+                    # Reject explicitly: clamping would leave the body
+                    # remainder in the stream to be parsed as the next
+                    # request line on a keep-alive connection (desync).
+                    # Drain what the client is mid-sending first, else it
+                    # sees a connection reset instead of the 413.
+                    left = clen
+                    while left > 0:
+                        chunk = await reader.read(min(left, 1 << 16))
+                        if not chunk:
+                            break
+                        left -= len(chunk)
+                    out = b'{"err":"body too large"}'
+                    writer.write(
+                        f"HTTP/1.1 413 Payload Too Large\r\n"
+                        f"Content-Type: application/json\r\n"
+                        f"Content-Length: {len(out)}\r\n"
+                        f"Connection: close\r\n\r\n".encode() + out)
+                    await writer.drain()
+                    return
                 body = await reader.readexactly(clen) if clen else b""
                 status, ctype, out = await self._route(method, path, body)
                 writer.write(

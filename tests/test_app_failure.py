@@ -1,0 +1,150 @@
+"""App-execution failure semantics (round-1 advisor findings).
+
+* A transient, replica-local exception from ``app.execute`` is retried in
+  place a bounded number of times — one replica applying an op while
+  another records an error would silently fork the RSM (ref: the upstream
+  retries app execution to keep replicas in lockstep).
+* Only a repeatable exception is declared deterministic: the slot still
+  advances (no wedge) and the client gets status 4.
+* A retransmit of a failed request is ANSWERED from the response cache
+  with its status-4 error — never re-proposed and re-executed in a new
+  slot.
+"""
+
+import socket
+import struct
+import time
+
+from gigapaxos_tpu.paxos import packets as pkt
+from gigapaxos_tpu.paxos.client import PaxosClient
+from gigapaxos_tpu.paxos.interfaces import CounterApp
+from tests.test_e2e import make_cluster, shutdown
+
+_LEN = struct.Struct("<I")
+
+
+class FlakyApp(CounterApp):
+    """b"boom*" payloads raise every time (deterministic failure);
+    b"flaky*" payloads raise on the first attempt only (transient)."""
+
+    def __init__(self):
+        super().__init__()
+        self.attempts = {}
+
+    def execute(self, name, req_id, payload, is_stop=False):
+        n = self.attempts[req_id] = self.attempts.get(req_id, 0) + 1
+        if payload.startswith(b"boom"):
+            raise RuntimeError("deterministic app failure")
+        if payload.startswith(b"flaky") and n == 1:
+            raise RuntimeError("transient app failure")
+        return super().execute(name, req_id, payload, is_stop)
+
+
+def _converged(nodes, name, count, deadline_s=10):
+    deadline = time.time() + deadline_s
+    while time.time() < deadline:
+        if all(nd.app.count.get(name, 0) == count for nd in nodes):
+            return True
+        time.sleep(0.05)
+    return False
+
+
+def test_transient_failure_retried_in_place(tmp_path):
+    nodes, addr_map = make_cluster(tmp_path, backend="scalar",
+                                   app_cls=FlakyApp)
+    try:
+        for nd in nodes:
+            nd.create_group("fl", (0, 1, 2))
+        cli = PaxosClient([addr_map[i] for i in range(3)], timeout=10)
+        try:
+            r = cli.send_request("fl", b"flaky-1")
+            assert r.status == 0
+            assert _converged(nodes, "fl", 1)
+            # every replica needed exactly one retry, none diverged
+            digests = {nd.app.digest.get("fl") for nd in nodes}
+            assert len(digests) == 1
+            for nd in nodes:
+                rid = next(i for i, n in nd.app.attempts.items() if n > 1)
+                assert nd.app.attempts[rid] == 2
+        finally:
+            cli.close()
+    finally:
+        shutdown(nodes)
+
+
+def test_deterministic_failure_advances_and_caches(tmp_path):
+    nodes, addr_map = make_cluster(tmp_path, backend="scalar",
+                                   app_cls=FlakyApp)
+    try:
+        for nd in nodes:
+            nd.create_group("bm", (0, 1, 2))
+        cli = PaxosClient([addr_map[i] for i in range(3)], timeout=10)
+        try:
+            assert cli.send_request("bm", b"ok-1").status == 0
+            r = cli.send_request("bm", b"boom-1")
+            assert r.status == 4, r
+            # the group is NOT wedged: later requests still execute
+            assert cli.send_request("bm", b"ok-2").status == 0
+            assert _converged(nodes, "bm", 2)
+            # all replicas tried 3 times then advanced identically
+            for nd in nodes:
+                rid = next(i for i, n in nd.app.attempts.items()
+                           if n >= 3)
+                assert nd.app.attempts[rid] == 3
+        finally:
+            cli.close()
+    finally:
+        shutdown(nodes)
+
+
+def test_failed_request_retransmit_answered_from_cache(tmp_path):
+    """Raw-socket retransmit with the SAME req_id: the second send must be
+    answered status 4 from the response cache without re-execution."""
+    nodes, addr_map = make_cluster(tmp_path, backend="scalar",
+                                   app_cls=FlakyApp)
+    try:
+        for nd in nodes:
+            nd.create_group("rt", (0, 1, 2))
+        gkey = pkt.group_key("rt")
+        entry = gkey % 3  # any replica works; pick deterministically
+        client_id = 7777
+        req_id = (client_id << 32) | 1
+        with socket.create_connection(addr_map[entry], timeout=10) as s:
+            s.sendall(_LEN.pack(4) + struct.pack("<i", client_id))
+            frame = pkt.Request(client_id, gkey, req_id, 0,
+                                b"boom-rt").encode()
+
+            def roundtrip():
+                s.sendall(_LEN.pack(len(frame)) + frame)
+                buf = b""
+                while True:
+                    while len(buf) < 4:
+                        buf += s.recv(65536)
+                    (ln,) = _LEN.unpack(buf[:4])
+                    while len(buf) < 4 + ln:
+                        buf += s.recv(65536)
+                    obj = pkt.decode(buf[4:4 + ln])
+                    buf = buf[4 + ln:]
+                    if isinstance(obj, pkt.Response) and \
+                            obj.req_id == req_id:
+                        return obj
+
+            r1 = roundtrip()
+            assert r1.status == 4, r1
+            # non-entry replicas execute the commit asynchronously —
+            # wait for all of them before snapshotting attempt counts
+            deadline = time.time() + 10
+            while time.time() < deadline:
+                if all(req_id in nd.app.attempts for nd in nodes):
+                    break
+                time.sleep(0.05)
+            attempts_before = [dict(nd.app.attempts) for nd in nodes]
+            assert all(req_id in a for a in attempts_before)
+            r2 = roundtrip()
+            assert r2.status == 4, r2
+            assert r2.payload == r1.payload
+            # answered from cache: no replica executed anything new
+            for nd, before in zip(nodes, attempts_before):
+                assert nd.app.attempts == before
+    finally:
+        shutdown(nodes)

@@ -59,6 +59,15 @@ def test_http_lifecycle(tmp_path):
                     assert False, "expected 400"
                 except urllib.error.HTTPError as e:
                     assert e.code == 400
+                # oversized body: explicit 413 + close, never a clamped
+                # read that desyncs the keep-alive stream
+                from gigapaxos_tpu.reconfiguration.http import MAX_BODY
+                try:
+                    await call(f"{base}/create",
+                               b"x" * (MAX_BODY + 1))
+                    assert False, "expected 413"
+                except urllib.error.HTTPError as e:
+                    assert e.code == 413
             finally:
                 await fe.stop()
         asyncio.run(body())

@@ -300,23 +300,32 @@ def test_demand_driven_move(tmp_path):
             cli = ReconfigurableAppClient(1 << 16, cfg, timeout=15)
             try:
                 assert await cli.create("hotname", b"")
-                before = sorted(await cli.get_actives("hotname"))
+                rcn = nodes[-1].reconfigurator
+                # NB: lookup returns the live record (mutated in place on
+                # commits) — snapshot the epoch NUMBER, not the object
+                ep0 = rcn.db.lookup(rcn.group_of("hotname"),
+                                    "hotname").epoch
                 # hammer through requests; entry active reports demand
                 for k in range(60):
                     await cli.send_request(
                         "hotname",
                         f'{{"op":"put","k":"x","v":"{k}"}}'.encode())
-                # wait for a demand-driven move to commit
+                # wait for a demand-driven move (epoch bump) to commit —
+                # compare EPOCHS, not active sets: placement may move
+                # several times during the hammer and oscillate back to
+                # the starting set by the time we poll
                 deadline = time.time() + 20
                 moved = False
                 while time.time() < deadline:
-                    cli._actives_cache.pop("hotname", None)
-                    now_actives = sorted(await cli.get_actives("hotname"))
-                    if now_actives != before:
+                    rec = rcn.db.lookup(rcn.group_of("hotname"),
+                                        "hotname")
+                    if rec is not None and rec.epoch > ep0 and \
+                            rec.state == "READY":
                         moved = True
                         break
                     await asyncio.sleep(0.3)
-                assert moved, f"never moved off {before}"
+                assert moved, f"epoch never advanced past {ep0}"
+                cli._actives_cache.pop("hotname", None)
                 # still serves requests after the move
                 r = await cli.send_request(
                     "hotname", b'{"op":"get","k":"x"}')
