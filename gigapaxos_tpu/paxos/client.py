@@ -182,13 +182,16 @@ class PaxosClient:
     convenience; the reference's sync ``PaxosClient`` analog)."""
 
     def __init__(self, servers: List[Tuple[str, int]],
-                 client_id: Optional[int] = None, timeout: float = 5.0):
+                 client_id: Optional[int] = None, timeout: float = 5.0,
+                 retries: int = 3, retransmit_s: float = 1.0):
         self._loop = asyncio.new_event_loop()
         self._thread = threading.Thread(target=self._loop.run_forever,
                                         daemon=True, name="gp-client")
         self._thread.start()
         cid = client_id or (1000 + next(_client_seq))
-        self.async_client = PaxosClientAsync(cid, servers, timeout=timeout)
+        self.async_client = PaxosClientAsync(cid, servers, timeout=timeout,
+                                             retries=retries,
+                                             retransmit_s=retransmit_s)
 
     def _run(self, coro):
         return asyncio.run_coroutine_threadsafe(coro, self._loop).result()

@@ -63,6 +63,23 @@ FLAG_MISSING = 4
 
 
 @dataclass
+class _InFlight:
+    """Coordinator-side in-flight proposal (dedupe + accept re-drive).
+
+    ``bal`` is the ballot the slot was assigned under: the re-drive only
+    ever retransmits at THAT ballot — re-emitting an old value at a newer
+    ballot could collide with the new regime's carryover at the same
+    (ballot, slot) and fork the RSM.  ``proposed`` feeds the GC reaper
+    (never refreshed); ``redriven`` paces the re-drive."""
+
+    row: int
+    slot: int
+    bal: int
+    proposed: float
+    redriven: float
+
+
+@dataclass
 class _Election:
     """Phase-1 bookkeeping at a would-be coordinator (host-side cold path;
     ref: ``PaxosCoordinatorState`` prepare phase)."""
@@ -105,22 +122,45 @@ class PaxosNode:
         self._cursor: Dict[int, int] = {}         # row -> host exec cursor
         self._dec: Dict[int, Dict[int, int]] = {}  # row -> slot -> req_id
         self._ckpt_slot: Dict[int, int] = {}      # row -> last ckpt slot
-        # req_id -> (flags, payload); GC'd at local execution (§7.3.5)
+        # req_id -> (flags, payload); popped at local execution
+        # (§7.3.5).  Two generations: entries untouched for two GC
+        # periods (never-decided requests) are dropped — see
+        # _payload_get.
         self._payloads: Dict[int, Tuple[int, bytes]] = {}
+        self._payloads_old: Dict[int, Tuple[int, bytes]] = {}
         # entry-replica reply table: req_id -> client node id
         # req_id -> (client/entry id, enqueue ts, gkey): clients waiting
         # on us as their entry replica for a not-yet-executed request
         self._client_wait: Dict[int, Tuple[int, float, int]] = {}
-        # coordinator dedupe: req_id -> (row, proposed-at) while the
-        # proposal is in flight.  The row lets a group delete purge its
-        # in-flight entries — otherwise a request proposed in a deleted
-        # epoch is blackholed at this node forever (every retransmit into
-        # the successor epoch hits the dedupe and is dropped).  The
-        # timestamp lets the periodic GC reap entries whose decision
-        # never landed (e.g. preempted accept, client gave up), which
-        # would otherwise dedupe the req_id and pin the row unpausable
-        # for the life of the process.
-        self._proposed: Dict[int, Tuple[int, float]] = {}
+        # coordinator dedupe: req_id -> in-flight record.  The row lets a
+        # group delete purge its entries — otherwise a request proposed
+        # in a deleted epoch is blackholed at this node forever (every
+        # retransmit into the successor epoch hits the dedupe and is
+        # dropped).  `proposed` feeds the GC reaping entries whose
+        # decision never landed (they would dedupe the req_id and pin the
+        # row unpausable forever); `redriven` paces the accept re-drive.
+        self._proposed: Dict[int, _InFlight] = {}
+        # currently-suspected peers (no ping within failure_timeout).
+        # Cleared the moment any frame from the peer arrives.  Drives the
+        # periodic run-for-coordinator re-check in _tick (ref:
+        # FailureDetection feeding checkRunForCoordinator periodically).
+        self._suspects: Set[int] = set()
+        # row -> [(parked-at, Proposal)]: client traffic that would have
+        # been forwarded to a suspect/unknown coordinator while an
+        # election is unsettled.  Flushed by _tick or on coordinator
+        # install; stale entries age out (client retransmit covers).
+        self._parked: Dict[int, List[Tuple[float, pkt.Proposal]]] = {}
+        # req_id -> last bounce ts: a stale-forwarded Proposal is bounced
+        # onward at most once per window — the second sighting parks it,
+        # breaking forward cycles without a wire-format TTL.
+        self._bounced: Dict[int, float] = {}
+        # row -> (highest slot this acceptor acked, last-accept ts).
+        # Catch-up trigger: accepted-but-undecided past the cursor for
+        # longer than a grace period means the commits were lost — with
+        # no later traffic there is no gap signal, so _tick pulls the
+        # missing decisions via _sync_if_gap (ref: SyncDecisionsPacket).
+        self._acc_high: Dict[int, Tuple[int, float]] = {}
+        self._batch_t0 = 0.0  # set per worker batch (_process)
         # rows whose epoch-stop request has executed: the RSM is closed —
         # later decided slots are skipped and clients told to re-resolve
         # (ref: PaxosInstanceStateMachine stopped/final-state logic)
@@ -319,7 +359,7 @@ class PaxosNode:
         for meta in metas:
             self.table.delete(meta.gkey)
             for d in (self._bal_seen, self._cursor, self._dec,
-                      self._ckpt_slot):
+                      self._ckpt_slot, self._acc_high):
                 d.pop(meta.row, None)
             self._elections.pop(meta.row, None)
             self._group_stopped.discard(meta.row)
@@ -331,9 +371,18 @@ class PaxosNode:
         # re-proposable when its retransmit arrives in the successor
         # epoch (same gkey, new instance) — stale entries blackhole it.
         dead_rows = {m.row for m in metas}
-        for rid in [r for r, rw in self._proposed.items()
-                    if rw[0] in dead_rows]:
+        for rid in [r for r, fl in self._proposed.items()
+                    if fl.row in dead_rows]:
             self._proposed.pop(rid, None)
+            self._payload_pop(rid)
+        for row in dead_rows:
+            # parked proposals from remote entry replicas: answer their
+            # waiting clients via the relay (locally-entered ones are
+            # answered through _client_wait below)
+            for _ts, p in self._parked.pop(row, []):
+                if p.sender != self.id:
+                    self._route(p.sender, pkt.Response(
+                        self.id, p.gkey, p.req_id, 3, b""))
         # Answer clients still waiting on an in-flight (undecided)
         # request for a deleted group: the delete is the cutoff — without
         # this they silently wait out their whole timeout.  Status 3
@@ -353,12 +402,27 @@ class PaxosNode:
     def _touch(self, row: int) -> None:
         self._last_active[row] = time.time()
 
+    def _sweep_idle(self, now: float) -> int:
+        """One deactivator sweep: pause up to pause_max_per_tick rows
+        idle past the threshold (called from _tick and from an unpause
+        that found the row table full)."""
+        if self.pause_idle_s <= 0:
+            return 0
+        cutoff = now - self.pause_idle_s
+        idle = []
+        for row, t in list(self._last_active.items()):
+            if t <= cutoff:
+                idle.append(row)
+                if len(idle) >= self.pause_max_per_tick:
+                    break
+        return self._pause_rows(idle) if idle else 0
+
     def _pause_rows(self, rows: List[int]) -> int:
         """Serialize idle groups to the pause table and free their rows:
         ONE device gather + ONE durable txn for the sweep.  A row is
         skipped while anything is in flight for it locally."""
         eligible = []
-        inflight_rows = {rw[0] for rw in self._proposed.values()}
+        inflight_rows = {fl.row for fl in self._proposed.values()}
         for row in rows:
             meta = self.table.by_row(row)
             if meta is None:
@@ -366,7 +430,8 @@ class PaxosNode:
                 continue
             if (row in self._elections or self._dec.get(row)
                     or row in self._group_stopped
-                    or row in inflight_rows):
+                    or row in inflight_rows
+                    or self._parked.get(row)):
                 # in-flight proposals pin the row: pausing it would orphan
                 # coordinator-dedupe entries across a row reuse
                 self._touch(row)  # re-check later
@@ -395,7 +460,7 @@ class PaxosNode:
         for row, meta in eligible:
             self.table.delete(meta.gkey)
             for d in (self._bal_seen, self._cursor, self._dec,
-                      self._ckpt_slot):
+                      self._ckpt_slot, self._acc_high):
                 d.pop(row, None)
             self._last_active.pop(row, None)
             self._paused.add(meta.gkey)
@@ -421,7 +486,7 @@ class PaxosNode:
         try:
             meta = self.table.create(d["name"], tuple(d["members"]),
                                      d["version"])
-        except (MemoryError, ValueError):
+        except MemoryError:
             # Capacity exhausted: leave the group cold-but-reachable and
             # fail only this lookup — propagating would drop the whole
             # worker batch (every unrelated packet in it) on each touch of
@@ -429,12 +494,13 @@ class PaxosNode:
             # rows before the client's retransmit lands.
             log.warning("unpause of %r deferred: row capacity exhausted",
                         d["name"])
-            if self.pause_idle_s > 0:
-                cutoff = time.time() - self.pause_idle_s
-                idle = [r for r, t in list(self._last_active.items())
-                        if t < cutoff][:self.pause_max_per_tick]
-                if idle:
-                    self._pause_rows(idle)
+            self._sweep_idle(time.time())
+            return None
+        except ValueError:
+            # 64-bit group-key collision with a live group: permanent —
+            # no sweep can help; surface it loudly and keep the batch
+            log.error("unpause of %r impossible: group-key collision",
+                      d["name"])
             return None
         self.backend.restore_row(meta.row, d["snap"])
         self._cursor[meta.row] = d["cursor"]
@@ -534,10 +600,27 @@ class PaxosNode:
     def _store_payload(self, req: int, flags: int, payload: bytes) -> None:
         """Keep the best copy: a real payload always beats a FLAG_MISSING
         placeholder, regardless of arrival order."""
-        cur = self._payloads.get(req)
+        cur = self._payload_get(req)  # promotes a hot old-gen entry
         if cur is None or ((cur[0] & FLAG_MISSING)
                            and not (flags & FLAG_MISSING)):
             self._payloads[req] = (flags, payload)
+
+    def _payload_get(self, req: int) -> Optional[Tuple[int, bytes]]:
+        """Two-generation payload lookup; touching an old-gen entry
+        promotes it (GCConcurrentHashMap-style time GC: anything
+        untouched for two GC periods is dropped — payloads of requests
+        whose decision never lands must not accumulate forever)."""
+        got = self._payloads.get(req)
+        if got is None:
+            got = self._payloads_old.pop(req, None)
+            if got is not None:
+                self._payloads[req] = got
+        return got
+
+    def _payload_pop(self, req: int) -> Optional[Tuple[int, bytes]]:
+        got = self._payloads.pop(req, None)
+        old = self._payloads_old.pop(req, None)
+        return got if got is not None else old
 
     def _route(self, dst: int, obj) -> None:
         """Send a packet object to ``dst``; self-sends loop back through
@@ -621,18 +704,92 @@ class PaxosNode:
                 if now - t > self.failure_timeout]
         for n in dead:
             self._on_node_dead(n)
+        # election liveness (ref: FailureDetection feeding a PERIODIC
+        # checkRunForCoordinator, SURVEY §3.5): one lost Prepare or
+        # PrepareReply must never wedge a group.  (a) re-drive stalled
+        # elections past the 2s backoff; (b) while any peer is suspect,
+        # rescan for rows still led by it (covers elections that never
+        # started: we weren't next in line, or the next-in-line died too)
+        if self._elections:
+            for row, el in list(self._elections.items()):
+                if now - el.started >= 2.0:
+                    meta = self.table.by_row(row)
+                    if meta is None:
+                        self._elections.pop(row, None)
+                    else:
+                        self._start_election(row, meta)
+        if self._suspects:
+            for meta in list(self.table):
+                if meta.row in self._elections:
+                    continue
+                coord = unpack_ballot(
+                    self._bal_seen.get(meta.row, NO_BALLOT))[1]
+                if coord in self._suspects:
+                    self._run_if_next_in_line(meta, coord, now)
+        # accept re-drive (ref: the coordinator's accept retransmitter):
+        # an in-flight proposal whose decision hasn't landed within ~1s
+        # is re-emitted to every member — a lost Accept otherwise stalls
+        # its slot forever (and every later one: execution is in-order),
+        # while client retransmits die on the _proposed dedupe.
+        if self._proposed:
+            n_redriven = 0
+            for req_id, fl in list(self._proposed.items()):
+                if now - fl.redriven < 1.0:
+                    continue
+                meta = self.table.by_row(fl.row)
+                if meta is None:
+                    continue
+                bal = self._bal_seen.get(fl.row, NO_BALLOT)
+                if bal != fl.bal or unpack_ballot(bal)[1] != self.id:
+                    # the regime changed since this slot was assigned:
+                    # NEVER re-emit at a different ballot (the carryover
+                    # may hold a different value at this slot — equal
+                    # ballot + different value forks the RSM); install-
+                    # time reconciliation re-stamps or re-proposes
+                    continue
+                got = self._payload_get(req_id)
+                if got is None:
+                    continue
+                fl.redriven = now
+                for m in meta.members:
+                    self._route(m, pkt.AcceptBatch(
+                        self.id, np.asarray([meta.gkey], np.uint64),
+                        np.asarray([fl.slot], np.int32),
+                        np.asarray([bal], np.int32),
+                        *_split_reqs([req_id]),
+                        payloads=[bytes([got[0]]) + got[1]]))
+                n_redriven += 1
+                if n_redriven >= 256:
+                    break
+        # catch-up: slots we acked an Accept for but never saw decided —
+        # the commit was lost and nothing later will signal a gap; pull
+        # the decisions (or a checkpoint) from the coordinator
+        if self._acc_high:
+            for row, (hi, ts) in list(self._acc_high.items()):
+                if self._cursor.get(row, 0) > hi:
+                    self._acc_high.pop(row, None)
+                elif now - ts > 0.5:
+                    self._sync_if_gap(row)
+        # re-route proposals parked while leadership was unsettled
+        if self._parked:
+            for row in list(self._parked):
+                meta = self.table.by_row(row)
+                if meta is None:
+                    self._parked.pop(row, None)
+                    continue
+                coord = unpack_ballot(
+                    self._bal_seen.get(row, NO_BALLOT))[1]
+                if row not in self._elections and coord >= 0 and \
+                        coord not in self._suspects:
+                    self._flush_parked(row)
+        if len(self._bounced) > 10000 or \
+                getattr(self, "_last_bounce_gc", 0) + 30 < now:
+            self._last_bounce_gc = now
+            self._bounced = {r: t for r, t in self._bounced.items()
+                             if t > now - 30}
         # deactivator pass (ref: PaxosManager's pause thread); batched:
         # one device gather + one pause txn per sweep
-        if self.pause_idle_s > 0:
-            cutoff = now - self.pause_idle_s
-            idle = []
-            for row, t in list(self._last_active.items()):
-                if t <= cutoff:
-                    idle.append(row)
-                    if len(idle) >= self.pause_max_per_tick:
-                        break
-            if idle:
-                self._pause_rows(idle)
+        self._sweep_idle(now)
         # GC the dedupe + response-cache + waiter tables (time TTL)
         if len(self._executed_recent) > 100000 or \
                 getattr(self, "_last_exec_gc", 0) + 30 < now:
@@ -651,13 +808,18 @@ class PaxosNode:
             # retransmit horizon a fresh proposal is the correct answer,
             # and a stale entry would pin its row unpausable forever
             self._proposed = {
-                r: rw for r, rw in self._proposed.items()
-                if rw[1] > now - 120}
+                r: fl for r, fl in self._proposed.items()
+                if fl.proposed > now - 120}
+            # payload generation shift: anything untouched since the
+            # last shift (no decide, no sync/prepare interest) ages out
+            self._payloads_old = self._payloads
+            self._payloads = {}
 
     # -- batch processing ----------------------------------------------
 
     def _process(self, batch: List) -> None:
         self._resp_out: Optional[Dict] = {}
+        self._batch_t0 = time.time()  # app-retry sleep budget anchor
         try:
             self._process_inner(batch)
         finally:
@@ -670,6 +832,7 @@ class PaxosNode:
             s = getattr(obj, "sender", None)
             if s is not None and s in self.addr_map:
                 self._last_heard[s] = time.time()
+                self._suspects.discard(s)
 
         # cold control path first (creates must precede traffic to them)
         for o in by_type.pop(pkt.CreateGroup, []):
@@ -754,6 +917,27 @@ class PaxosNode:
 
     # -- request/proposal → propose ------------------------------------
 
+    def _park(self, row: int, prop: "pkt.Proposal") -> None:
+        """Hold a proposal while the row's leadership is unsettled
+        (election in flight / coordinator suspect or unknown) instead of
+        forwarding it into a black hole."""
+        q = self._parked.setdefault(row, [])
+        if len(q) >= 512:
+            q.pop(0)  # oldest first; its client retransmit covers it
+        q.append((time.time(), prop))
+
+    def _flush_parked(self, row: int) -> None:
+        """Re-inject parked proposals now that leadership settled (we won,
+        or a live coordinator is known): the normal path forwards or
+        proposes them."""
+        q = self._parked.pop(row, None)
+        if not q:
+            return
+        now = time.time()
+        live = [p for ts, p in q if now - ts < 10.0]
+        if live:
+            self._handle_requests([], live)
+
     def _handle_requests(self, reqs: List, props: List) -> None:
         lanes: List[Tuple[int, int, int, bytes, int]] = []  # row,req,fl,pl,en
         for o in reqs:
@@ -776,8 +960,16 @@ class PaxosNode:
             self._client_wait[o.req_id] = (o.sender, time.time(), o.gkey)
             coord = unpack_ballot(self._bal_seen[meta.row])[1]
             if coord != self.id:
-                self._route(coord, pkt.Proposal(
-                    self.id, o.gkey, o.req_id, o.sender, o.flags, o.payload))
+                prop = pkt.Proposal(
+                    self.id, o.gkey, o.req_id, o.sender, o.flags, o.payload)
+                if (meta.row in self._elections or coord < 0
+                        or coord in self._suspects):
+                    # leadership unsettled: park instead of forwarding to
+                    # a dead/unknown coordinator (the old behavior black-
+                    # holed every request until the client re-routed)
+                    self._park(meta.row, prop)
+                else:
+                    self._route(coord, prop)
                 continue
             if o.req_id in self._proposed:
                 continue
@@ -806,10 +998,32 @@ class PaxosNode:
                 continue
             coord = unpack_ballot(self._bal_seen[meta.row])[1]
             if coord != self.id:
-                # not us (stale forward): bounce onward, bounded by TTL-less
-                # design — the client retries if it loops
-                if coord >= 0 and coord != o.sender:
-                    self._route(coord, o)
+                # not us (stale forward): park while leadership is
+                # unsettled; otherwise bounce onward AT MOST once per
+                # window (the second sighting parks — breaks forward
+                # cycles between stale views without a wire TTL)
+                if (meta.row in self._elections or coord < 0
+                        or coord in self._suspects):
+                    self._park(meta.row, o)
+                elif coord == o.sender:
+                    # mutual disagreement (sender believes us, we believe
+                    # sender): park, and on a REPEAT sighting force a
+                    # view repair by running for coordinator ourselves —
+                    # nothing else breaks a stable standoff on an
+                    # otherwise idle row
+                    t = time.time()
+                    if t - self._bounced.get(o.req_id, 0.0) < 10.0:
+                        self._start_election(meta.row, meta)
+                    else:
+                        self._bounced[o.req_id] = t
+                    self._park(meta.row, o)
+                else:
+                    t = time.time()
+                    if t - self._bounced.get(o.req_id, 0.0) < 5.0:
+                        self._park(meta.row, o)
+                    else:
+                        self._bounced[o.req_id] = t
+                        self._route(coord, o)
                 continue
             if o.req_id in self._proposed:
                 continue
@@ -824,7 +1038,9 @@ class PaxosNode:
         res = self.backend.propose(rows, req_ids)
         for i, (row, req_id, flags, payload, entry) in enumerate(lanes):
             if res.granted[i]:
-                self._proposed[req_id] = (row, now)
+                self._proposed[req_id] = _InFlight(
+                    row, int(res.slot[i]),
+                    self._bal_seen.get(row, NO_BALLOT), now, now)
                 self._store_payload(req_id, flags, payload)
             elif res.rejected[i]:
                 # we believed we coordinate this group but the device
@@ -903,6 +1119,9 @@ class PaxosNode:
             flags, payload = (blob[0], bytes(blob[1:])) if blob \
                 else (0, b"")
             row, bal = int(rows[i]), int(bals[i])
+            ah = self._acc_high.get(row)
+            self._acc_high[row] = (
+                max(int(slots[i]), ah[0]) if ah else int(slots[i]), now)
             self._store_payload(req, flags, payload)
             self._bal_seen[row] = max(self._bal_seen.get(row, NO_BALLOT),
                                       bal)
@@ -1040,13 +1259,13 @@ class PaxosNode:
         dec = self._dec[row]
         while cur in dec:
             req_id = dec[cur]
-            got = self._payloads.get(req_id)
+            got = self._payload_get(req_id)
             if got is None or (got[0] & FLAG_MISSING):
                 # we never saw the accept (gap): ask peers, stop here
                 self._sync_if_gap(row)
                 break
             dec.pop(cur)
-            flags, payload = self._payloads.pop(req_id)
+            flags, payload = self._payload_pop(req_id)
             status = 0
             if flags & FLAG_NOOP:
                 resp = b""
@@ -1076,8 +1295,12 @@ class PaxosNode:
                             meta.name, cur, attempt + 1)
                         # brief growing backoff so a sub-second transient
                         # (fd/disk pressure) isn't misread as
-                        # deterministic on just this replica
-                        if backoff:
+                        # deterministic on just this replica — but capped
+                        # per worker batch: a BURST of failing requests
+                        # must not stall the single worker long enough to
+                        # trip peers' failure detectors
+                        if backoff and \
+                                time.time() < self._batch_t0 + 0.5:
                             time.sleep(backoff)
                 else:
                     resp, status = b'{"err":"app exception"}', 4
@@ -1149,7 +1372,7 @@ class PaxosNode:
         have = []
         for s in range(o.from_slot, o.to_slot):
             req = self._dec.get(row, {}).get(s)
-            if req is not None and req in self._payloads:
+            if req is not None and self._payload_get(req) is not None:
                 have.append((s, req))
         if not have:
             # decisions already executed & GC'd: catch the laggard up with
@@ -1162,7 +1385,7 @@ class PaxosNode:
             return
         pls = []
         for s, req in have:
-            fl, pl = self._payloads[req]
+            fl, pl = self._payload_get(req)
             pls.append(bytes([fl]) + pl)
         self._route(o.sender, pkt.SyncReply(
             self.id, meta.gkey,
@@ -1209,7 +1432,7 @@ class PaxosNode:
         self._cursor[row] = newcur
         d = self._dec.get(row, {})
         for s in [s for s in d if s < newcur]:
-            self._payloads.pop(d.pop(s), None)
+            self._payload_pop(d.pop(s))
         self.backend.set_cursor(np.asarray([row], np.int32),
                                 np.asarray([newcur], np.int32),
                                 np.asarray([newcur], np.int32))
@@ -1227,6 +1450,7 @@ class PaxosNode:
         """Scan groups whose believed coordinator is ``node``; if self is
         next in line (deterministic order), run phase 1 for them."""
         self._last_heard.pop(node, None)
+        self._suspects.add(node)
         log.info("node %d: peer %d suspected dead", self.id, node)
         now = time.time()
         for meta in list(self.table):
@@ -1291,7 +1515,7 @@ class PaxosNode:
             for j in range(m):
                 req = _join_req(int(res.win_req_lo[i][j]),
                                 int(res.win_req_hi[i][j]))
-                got = self._payloads.get(req)
+                got = self._payload_get(req)
                 # never fabricate a payload we don't hold: report the
                 # pvalue (safety requires it) but flag it payload-less
                 fl, pl = got if got is not None else (FLAG_MISSING, b"")
@@ -1348,7 +1572,7 @@ class PaxosNode:
         # fill payload-less carryovers from our own store when possible
         for s, (b, req, fl, pl) in list(carry.items()):
             if fl & FLAG_MISSING:
-                got = self._payloads.get(req)
+                got = self._payload_get(req)
                 if got is not None:
                     carry[s] = (b, req, got[0], got[1])
         top = max(carry.keys(), default=cursor - 1)
@@ -1370,6 +1594,27 @@ class PaxosNode:
         self._bal_seen[row] = el.bal
         log.info("node %d now coordinator of %s at bal %d (carry %d)",
                  self.id, meta.name, el.bal, len(carry))
+        # reconcile OUR in-flight proposals with the new regime: entries
+        # whose request survived into the carryover are re-stamped to the
+        # carry slot/ballot (so the re-drive covers lost carry-accepts);
+        # orphans (request absent from the quorum's view — its accept
+        # reached nobody) are re-proposed fresh under the new ballot
+        slot_of = {v[1]: s for s, v in carry.items()}
+        reprops = []
+        for rid, fl in [(r, f) for r, f in self._proposed.items()
+                        if f.row == row]:
+            if rid in slot_of:
+                fl.slot, fl.bal = slot_of[rid], el.bal
+                fl.redriven = time.time()
+            else:
+                self._proposed.pop(rid, None)
+                got = self._payload_get(rid)
+                if got is not None and not (got[0] & FLAG_MISSING):
+                    reprops.append(pkt.Proposal(
+                        self.id, meta.gkey, rid, self.id, got[0], got[1]))
+        self._flush_parked(row)
+        if reprops:
+            self._handle_requests([], reprops)
         # re-propose carryover pvalues at our ballot
         if carry:
             for m in meta.members:

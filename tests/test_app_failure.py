@@ -131,15 +131,18 @@ def test_failed_request_retransmit_answered_from_cache(tmp_path):
 
             r1 = roundtrip()
             assert r1.status == 4, r1
-            # non-entry replicas execute the commit asynchronously —
-            # wait for all of them before snapshotting attempt counts
+            # non-entry replicas execute the commit asynchronously and
+            # the deterministic failure burns all 3 retries (with
+            # backoff) — wait for every replica to finish all of them
+            # before snapshotting attempt counts
             deadline = time.time() + 10
             while time.time() < deadline:
-                if all(req_id in nd.app.attempts for nd in nodes):
+                if all(nd.app.attempts.get(req_id, 0) >= 3
+                       for nd in nodes):
                     break
                 time.sleep(0.05)
             attempts_before = [dict(nd.app.attempts) for nd in nodes]
-            assert all(req_id in a for a in attempts_before)
+            assert all(a.get(req_id) == 3 for a in attempts_before)
             r2 = roundtrip()
             assert r2.status == 4, r2
             assert r2.payload == r1.payload

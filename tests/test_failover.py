@@ -66,6 +66,64 @@ def test_coordinator_failover(tmp_path):
         shutdown([nd for nd in nodes if not nd._stopping])
 
 
+def test_failover_under_message_loss(tmp_path):
+    """Coordinator crash with 20% loss on EVERY link: the periodic
+    run-for-coordinator re-check + election re-drive must converge — a
+    single lost Prepare/PrepareReply used to wedge the group forever
+    (round-1 verdict, ref: FailureDetection feeding a periodic
+    checkRunForCoordinator, SURVEY §3.5)."""
+    Config.set(PC.PING_INTERVAL_S, 0.15)
+    Config.set(PC.FAILURE_TIMEOUT_S, 1.0)
+    nodes, addr_map = make_cluster(tmp_path, backend="scalar")
+    cli = None
+    try:
+        name = "lossy-fo"
+        for nd in nodes:
+            assert nd.create_group(name, (0, 1, 2))
+        dead = group_key(name) % 3  # deterministic initial coordinator
+        cli = PaxosClient([addr_map[i] for i in range(3) if i != dead],
+                          timeout=8, retransmit_s=0.25)
+        for k in range(3):
+            assert cli.send_request(name, f"pre-{k}".encode()).status == 0
+        time.sleep(0.5)  # pings flow; survivors know everyone
+        for nd in nodes:
+            nd.transport.test_drop_rate = 0.2
+        nodes[dead].stop()
+        # liveness under loss: every request must eventually land —
+        # retransmits + parked proposals + periodic election re-drive
+        deadline = time.time() + 60
+        done = 0
+        k = 0
+        while done < 10 and time.time() < deadline:
+            try:
+                r = cli.send_request(name, f"post-{k}".encode())
+                done += int(r.status == 0)
+            except TimeoutError:
+                pass
+            k += 1
+        assert done >= 10, f"only {done}/10 decided under loss"
+        live = [nd for i, nd in enumerate(nodes) if i != dead]
+        row = live[0].table.by_name(name).row
+        _num, coord = unpack_ballot(live[0]._bal_seen[row])
+        assert coord != dead
+        # safety: stop the chaos, let commits settle, digests must agree
+        for nd in live:
+            nd.transport.test_drop_rate = 0.0
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            if len({nd.app.digest.get(name) for nd in live}) == 1 and \
+                    len({nd.app.count.get(name) for nd in live}) == 1:
+                break
+            time.sleep(0.1)
+        assert len({nd.app.digest.get(name) for nd in live}) == 1
+        counts = {nd.app.count.get(name) for nd in live}
+        assert len(counts) == 1 and counts.pop() >= 3 + done
+    finally:
+        if cli:
+            cli.close()
+        shutdown([nd for nd in nodes if not nd._stopping])
+
+
 def test_crash_recovery_single_node(tmp_path):
     Config.set(PC.SYNC_WAL, False)
     import socket
