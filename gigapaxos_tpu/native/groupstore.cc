@@ -1,0 +1,723 @@
+// Native per-instance group store: the C++ AcceptorBackend.
+//
+// Reference analog: gigapaxos/PaxosAcceptor.java + PaxosCoordinator.java
+// hot loops — the per-instance state machine the reference runs in plain
+// Java.  The TPU rebuild keeps that per-instance architecture available as
+// a *host* engine behind the same AcceptorBackend SPI as the columnar JAX
+// backend: it is (a) the honest fast baseline for the >=10x TPU comparison
+// (a JIT'd JVM is 10-100x faster than CPython; this C++ engine plays that
+// role), and (b) the trickle-traffic / low-latency path of SURVEY §7.3.3.
+//
+// Memory layout is struct-of-arrays over [capacity] x [W] rings — the same
+// columnar shape as the device arrays (ops/types.py), so a row snapshot is
+// a strided copy.  Slot-keyed maps of the Python oracle (ops/oracle.py)
+// become slot%W rings with a slot stamp; all live slots are within
+// [exec_cursor, exec_cursor+W) by construction (accept/commit bounds), so
+// the ring never aliases.
+//
+// C ABI only (ctypes); caller owns all numpy buffers.  Single-threaded by
+// contract: the node worker thread is the only caller (same single-writer
+// discipline as the manager).
+
+#include <cstdint>
+#include <cstring>
+#include <cstdlib>
+
+namespace {
+
+constexpr int32_t kNoBallot = -1;  // matches ops/types.py NO_BALLOT
+constexpr int32_t kNoSlot = -1;
+
+struct Store {
+  int64_t cap;
+  int32_t W;
+  // per-row scalars
+  int32_t *bal, *cbal, *exec_cursor, *next_slot, *gc_slot, *version;
+  int32_t *members;
+  uint8_t *is_coord, *coord_active, *active;
+  // [cap*W] rings, indexed row*W + slot%W, valid iff *_slot stamp matches
+  int32_t *acc_slot, *acc_bal;
+  uint64_t *acc_req;
+  int32_t *dec_slot;
+  uint64_t *dec_req;
+  int32_t *vote_slot;
+  uint64_t *votes, *prop_req;
+  uint8_t *emitted;
+};
+
+template <typename T>
+T* zalloc(int64_t n) { return (T*)std::calloc(n, sizeof(T)); }
+
+inline int popcount64(uint64_t x) {
+#if defined(__GNUC__)
+  return __builtin_popcountll(x);
+#else
+  int c = 0; while (x) { x &= x - 1; ++c; } return c;
+#endif
+}
+
+}  // namespace
+
+extern "C" {
+
+void* gp_gs_new(int64_t cap, int32_t W) {
+  Store* s = zalloc<Store>(1);
+  if (!s) return nullptr;
+  s->cap = cap;
+  s->W = W;
+  const int64_t cw = cap * W;
+  s->bal = zalloc<int32_t>(cap);
+  s->cbal = zalloc<int32_t>(cap);
+  s->exec_cursor = zalloc<int32_t>(cap);
+  s->next_slot = zalloc<int32_t>(cap);
+  s->gc_slot = zalloc<int32_t>(cap);
+  s->version = zalloc<int32_t>(cap);
+  s->members = zalloc<int32_t>(cap);
+  s->is_coord = zalloc<uint8_t>(cap);
+  s->coord_active = zalloc<uint8_t>(cap);
+  s->active = zalloc<uint8_t>(cap);
+  s->acc_slot = zalloc<int32_t>(cw);
+  s->acc_bal = zalloc<int32_t>(cw);
+  s->acc_req = zalloc<uint64_t>(cw);
+  s->dec_slot = zalloc<int32_t>(cw);
+  s->dec_req = zalloc<uint64_t>(cw);
+  s->vote_slot = zalloc<int32_t>(cw);
+  s->votes = zalloc<uint64_t>(cw);
+  s->prop_req = zalloc<uint64_t>(cw);
+  s->emitted = zalloc<uint8_t>(cw);
+  if (!s->bal || !s->cbal || !s->exec_cursor || !s->next_slot ||
+      !s->gc_slot || !s->version || !s->members || !s->is_coord ||
+      !s->coord_active || !s->active || !s->acc_slot || !s->acc_bal ||
+      !s->acc_req || !s->dec_slot || !s->dec_req || !s->vote_slot ||
+      !s->votes || !s->prop_req || !s->emitted)
+    return nullptr;  // leak on OOM path is fine: process is dying anyway
+  return s;
+}
+
+void gp_gs_free(void* h) {
+  if (!h) return;
+  Store* s = (Store*)h;
+  std::free(s->bal); std::free(s->cbal); std::free(s->exec_cursor);
+  std::free(s->next_slot); std::free(s->gc_slot); std::free(s->version);
+  std::free(s->members); std::free(s->is_coord); std::free(s->coord_active);
+  std::free(s->active); std::free(s->acc_slot); std::free(s->acc_bal);
+  std::free(s->acc_req); std::free(s->dec_slot); std::free(s->dec_req);
+  std::free(s->vote_slot); std::free(s->votes); std::free(s->prop_req);
+  std::free(s->emitted);
+  std::free(s);
+}
+
+void gp_gs_create(void* h, int64_t n, const int32_t* rows,
+                  const int32_t* members, const int32_t* versions,
+                  const int32_t* init_bal, const uint8_t* self_coord) {
+  Store* s = (Store*)h;
+  for (int64_t i = 0; i < n; ++i) {
+    const int64_t r = rows[i];
+    if (r < 0 || r >= s->cap) continue;
+    s->active[r] = 1;
+    s->bal[r] = init_bal[i];
+    s->members[r] = members[i];
+    s->version[r] = versions[i];
+    s->exec_cursor[r] = 0;
+    s->next_slot[r] = 0;
+    s->gc_slot[r] = kNoSlot;
+    s->is_coord[r] = self_coord[i];
+    s->coord_active[r] = self_coord[i];
+    s->cbal[r] = self_coord[i] ? init_bal[i] : kNoBallot;
+    const int64_t base = r * s->W;
+    for (int32_t w = 0; w < s->W; ++w) {
+      s->acc_slot[base + w] = kNoSlot;
+      s->dec_slot[base + w] = kNoSlot;
+      s->vote_slot[base + w] = kNoSlot;
+    }
+  }
+}
+
+void gp_gs_delete(void* h, int64_t n, const int32_t* rows) {
+  Store* s = (Store*)h;
+  for (int64_t i = 0; i < n; ++i) {
+    const int64_t r = rows[i];
+    if (r >= 0 && r < s->cap) s->active[r] = 0;
+  }
+}
+
+// accept: ref PaxosAcceptor.acceptAndUpdateBallot (oracle.accept)
+void gp_gs_accept(void* h, int64_t n, const int32_t* rows,
+                  const int32_t* slots, const int32_t* bals,
+                  const uint64_t* reqs, uint8_t* acked, uint8_t* stale,
+                  uint8_t* ow, int32_t* cur_bal) {
+  Store* s = (Store*)h;
+  const int32_t W = s->W;
+  for (int64_t i = 0; i < n; ++i) {
+    const int64_t r = rows[i];
+    acked[i] = stale[i] = ow[i] = 0;
+    cur_bal[i] = kNoBallot;
+    if (r < 0 || r >= s->cap || !s->active[r]) continue;
+    const int32_t slot = slots[i], bal = bals[i];
+    const int32_t cursor = s->exec_cursor[r];
+    const bool st = slot < cursor;
+    if (bal >= s->bal[r]) {
+      s->bal[r] = bal;
+    } else {
+      stale[i] = st;
+      cur_bal[i] = s->bal[r];
+      continue;
+    }
+    cur_bal[i] = s->bal[r];
+    if (st) { acked[i] = 1; stale[i] = 1; continue; }
+    if (slot >= cursor + W) { ow[i] = 1; continue; }
+    const int64_t w = r * W + (slot % W);
+    s->acc_slot[w] = slot;
+    s->acc_bal[w] = bal;
+    s->acc_req[w] = reqs[i];
+    acked[i] = 1;
+  }
+}
+
+// propose: ref PaxosCoordinator.propose slot assignment (oracle.propose)
+// status: 0 granted, 1 rejected, 2 throttled
+void gp_gs_propose(void* h, int64_t n, const int32_t* rows,
+                   const uint64_t* reqs, uint8_t* status, int32_t* slot_out,
+                   int32_t* cbal_out) {
+  Store* s = (Store*)h;
+  const int32_t W = s->W;
+  for (int64_t i = 0; i < n; ++i) {
+    const int64_t r = rows[i];
+    status[i] = 1;
+    slot_out[i] = kNoSlot;
+    cbal_out[i] = kNoBallot;
+    if (r < 0 || r >= s->cap || !s->active[r]) continue;
+    cbal_out[i] = s->cbal[r];
+    if (!(s->is_coord[r] && s->coord_active[r])) continue;
+    const int32_t slot = s->next_slot[r];
+    if (slot >= s->exec_cursor[r] + W) { status[i] = 2; continue; }
+    s->next_slot[r] = slot + 1;
+    const int64_t w = r * W + (slot % W);
+    s->vote_slot[w] = slot;
+    s->votes[w] = 0;
+    s->prop_req[w] = reqs[i];
+    s->emitted[w] = 0;
+    status[i] = 0;
+    slot_out[i] = slot;
+  }
+}
+
+// accept_reply: ref PaxosCoordinator.handleAcceptReply majority counting
+void gp_gs_accept_reply(void* h, int64_t n, const int32_t* rows,
+                        const int32_t* slots, const int32_t* bals,
+                        const int32_t* senders, const uint8_t* acked,
+                        uint8_t* newly, uint8_t* preempted,
+                        uint64_t* dec_req, int32_t* dec_bal) {
+  Store* s = (Store*)h;
+  const int32_t W = s->W;
+  for (int64_t i = 0; i < n; ++i) {
+    const int64_t r = rows[i];
+    newly[i] = preempted[i] = 0;
+    dec_req[i] = 0;
+    dec_bal[i] = kNoBallot;
+    if (r < 0 || r >= s->cap || !s->active[r]) continue;
+    if (!acked[i]) {
+      if (s->is_coord[r] && bals[i] > s->cbal[r]) {
+        s->is_coord[r] = 0;
+        s->coord_active[r] = 0;
+        preempted[i] = 1;
+      }
+      continue;
+    }
+    if (!(s->is_coord[r] && s->coord_active[r] && bals[i] == s->cbal[r]))
+      continue;
+    const int32_t slot = slots[i];
+    const int64_t w = r * W + (slot % W);
+    if (s->vote_slot[w] != slot) continue;
+    s->votes[w] |= (uint64_t)1 << (senders[i] & 63);
+    const int32_t maj = s->members[r] / 2 + 1;
+    if (popcount64(s->votes[w]) >= maj && !s->emitted[w]) {
+      s->emitted[w] = 1;
+      newly[i] = 1;
+      dec_req[i] = s->prop_req[w];
+      dec_bal[i] = s->cbal[r];
+    }
+  }
+}
+
+// commit: decision install + in-order cursor advance (oracle.commit)
+void gp_gs_commit(void* h, int64_t n, const int32_t* rows,
+                  const int32_t* slots, const uint64_t* reqs,
+                  uint8_t* applied, uint8_t* stale, uint8_t* ow,
+                  int32_t* new_cursor) {
+  Store* s = (Store*)h;
+  const int32_t W = s->W;
+  for (int64_t i = 0; i < n; ++i) {
+    const int64_t r = rows[i];
+    applied[i] = stale[i] = ow[i] = 0;
+    new_cursor[i] = 0;
+    if (r < 0 || r >= s->cap || !s->active[r]) continue;
+    const int32_t slot = slots[i];
+    int32_t cursor = s->exec_cursor[r];
+    if (slot < cursor) { stale[i] = 1; new_cursor[i] = cursor; continue; }
+    if (slot >= cursor + W) { ow[i] = 1; new_cursor[i] = cursor; continue; }
+    const int64_t base = r * W;
+    s->dec_slot[base + slot % W] = slot;
+    s->dec_req[base + slot % W] = reqs[i];
+    while (s->dec_slot[base + cursor % W] == cursor) ++cursor;
+    s->exec_cursor[r] = cursor;
+    applied[i] = 1;
+    new_cursor[i] = cursor;
+  }
+}
+
+// prepare: ballot promise + accepted-window report (oracle.prepare).
+// win_* are [n, W] row-major; entries beyond the live count have
+// win_slot == kNoSlot.  Live pvalues are emitted sorted by slot.
+void gp_gs_prepare(void* h, int64_t n, const int32_t* rows,
+                   const int32_t* bals, uint8_t* acked, int32_t* cur_bal,
+                   int32_t* cursor_out, int32_t* win_slot, int32_t* win_bal,
+                   uint64_t* win_req) {
+  Store* s = (Store*)h;
+  const int32_t W = s->W;
+  for (int64_t i = 0; i < n; ++i) {
+    const int64_t r = rows[i];
+    acked[i] = 0;
+    cur_bal[i] = kNoBallot;
+    cursor_out[i] = 0;
+    int32_t* ws = win_slot + i * W;
+    int32_t* wb = win_bal + i * W;
+    uint64_t* wr = win_req + i * W;
+    for (int32_t w = 0; w < W; ++w) {
+      ws[w] = kNoSlot; wb[w] = kNoBallot; wr[w] = 0;
+    }
+    if (r < 0 || r >= s->cap || !s->active[r]) continue;
+    if (bals[i] >= s->bal[r]) { s->bal[r] = bals[i]; acked[i] = 1; }
+    cur_bal[i] = s->bal[r];
+    const int32_t cursor = s->exec_cursor[r];
+    cursor_out[i] = cursor;
+    const int64_t base = r * W;
+    int32_t m = 0;
+    // slots in [cursor, cursor+W) ascending -> sorted output for free
+    for (int32_t slot = cursor; slot < cursor + W; ++slot) {
+      const int64_t w = base + slot % W;
+      if (s->acc_slot[w] == slot) {
+        ws[m] = slot; wb[m] = s->acc_bal[w]; wr[m] = s->acc_req[w];
+        ++m;
+      }
+    }
+  }
+}
+
+void gp_gs_install(void* h, int64_t n, const int32_t* rows,
+                   const int32_t* cbals, const int32_t* next_slots,
+                   int32_t M, const int32_t* carry_slot,
+                   const uint64_t* carry_req) {
+  Store* s = (Store*)h;
+  const int32_t W = s->W;
+  for (int64_t i = 0; i < n; ++i) {
+    const int64_t r = rows[i];
+    if (r < 0 || r >= s->cap || !s->active[r]) continue;
+    s->is_coord[r] = 1;
+    s->coord_active[r] = 1;
+    s->cbal[r] = cbals[i];
+    s->next_slot[r] = next_slots[i];
+    const int64_t base = r * W;
+    for (int32_t j = 0; j < M; ++j) {
+      const int32_t slot = carry_slot[i * M + j];
+      if (slot < 0) continue;
+      const int64_t w = base + slot % W;
+      s->vote_slot[w] = slot;
+      s->votes[w] = 0;
+      s->prop_req[w] = carry_req[i * M + j];
+      s->emitted[w] = 0;
+    }
+  }
+}
+
+void gp_gs_set_cursor(void* h, int64_t n, const int32_t* rows,
+                      const int32_t* cursors, const int32_t* next_slots) {
+  Store* s = (Store*)h;
+  for (int64_t i = 0; i < n; ++i) {
+    const int64_t r = rows[i];
+    if (r < 0 || r >= s->cap || !s->active[r]) continue;
+    s->exec_cursor[r] = cursors[i];
+    if (next_slots[i] > s->next_slot[r]) s->next_slot[r] = next_slots[i];
+  }
+}
+
+void gp_gs_gc(void* h, int64_t n, const int32_t* rows,
+              const int32_t* upto) {
+  Store* s = (Store*)h;
+  const int32_t W = s->W;
+  for (int64_t i = 0; i < n; ++i) {
+    const int64_t r = rows[i];
+    if (r < 0 || r >= s->cap || !s->active[r]) continue;
+    if (upto[i] > s->gc_slot[r]) s->gc_slot[r] = upto[i];
+    const int64_t base = r * W;
+    for (int32_t w = 0; w < W; ++w) {
+      if (s->acc_slot[base + w] != kNoSlot &&
+          s->acc_slot[base + w] <= upto[i])
+        s->acc_slot[base + w] = kNoSlot;
+      if (s->dec_slot[base + w] != kNoSlot &&
+          s->dec_slot[base + w] <= upto[i])
+        s->dec_slot[base + w] = kNoSlot;
+      if (s->vote_slot[w + base] != kNoSlot &&
+          s->vote_slot[w + base] <= upto[i])
+        s->vote_slot[w + base] = kNoSlot;
+    }
+  }
+}
+
+int32_t gp_gs_cursor_of(void* h, int32_t row) {
+  Store* s = (Store*)h;
+  if (row < 0 || row >= s->cap) return 0;
+  return s->exec_cursor[row];
+}
+
+// row snapshot for pause (ref HotRestoreInfo): scalars + the three rings.
+// Buffers: scal i32[8] = {bal,cbal,exec_cursor,next_slot,gc_slot,version,
+// members, is_coord<<1|coord_active}; rings as in the field order below.
+void gp_gs_snapshot(void* h, int32_t row, int32_t* scal, int32_t* a_slot,
+                    int32_t* a_bal, uint64_t* a_req, int32_t* d_slot,
+                    uint64_t* d_req, int32_t* v_slot, uint64_t* v_votes,
+                    uint64_t* v_req, uint8_t* v_emitted) {
+  Store* s = (Store*)h;
+  const int32_t W = s->W;
+  const int64_t base = (int64_t)row * W;
+  scal[0] = s->bal[row]; scal[1] = s->cbal[row];
+  scal[2] = s->exec_cursor[row]; scal[3] = s->next_slot[row];
+  scal[4] = s->gc_slot[row]; scal[5] = s->version[row];
+  scal[6] = s->members[row];
+  scal[7] = (s->is_coord[row] << 1) | s->coord_active[row];
+  std::memcpy(a_slot, s->acc_slot + base, W * 4);
+  std::memcpy(a_bal, s->acc_bal + base, W * 4);
+  std::memcpy(a_req, s->acc_req + base, W * 8);
+  std::memcpy(d_slot, s->dec_slot + base, W * 4);
+  std::memcpy(d_req, s->dec_req + base, W * 8);
+  std::memcpy(v_slot, s->vote_slot + base, W * 4);
+  std::memcpy(v_votes, s->votes + base, W * 8);
+  std::memcpy(v_req, s->prop_req + base, W * 8);
+  std::memcpy(v_emitted, s->emitted + base, W);
+}
+
+void gp_gs_restore(void* h, int32_t row, const int32_t* scal,
+                   const int32_t* a_slot, const int32_t* a_bal,
+                   const uint64_t* a_req, const int32_t* d_slot,
+                   const uint64_t* d_req, const int32_t* v_slot,
+                   const uint64_t* v_votes, const uint64_t* v_req,
+                   const uint8_t* v_emitted) {
+  Store* s = (Store*)h;
+  const int32_t W = s->W;
+  const int64_t base = (int64_t)row * W;
+  s->active[row] = 1;
+  s->bal[row] = scal[0]; s->cbal[row] = scal[1];
+  s->exec_cursor[row] = scal[2]; s->next_slot[row] = scal[3];
+  s->gc_slot[row] = scal[4]; s->version[row] = scal[5];
+  s->members[row] = scal[6];
+  s->is_coord[row] = (scal[7] >> 1) & 1;
+  s->coord_active[row] = scal[7] & 1;
+  std::memcpy(s->acc_slot + base, a_slot, W * 4);
+  std::memcpy(s->acc_bal + base, a_bal, W * 4);
+  std::memcpy(s->acc_req + base, a_req, W * 8);
+  std::memcpy(s->dec_slot + base, d_slot, W * 4);
+  std::memcpy(s->dec_req + base, d_req, W * 8);
+  std::memcpy(s->vote_slot + base, v_slot, W * 4);
+  std::memcpy(s->votes + base, v_votes, W * 8);
+  std::memcpy(s->prop_req + base, v_req, W * 8);
+  std::memcpy(s->emitted + base, v_emitted, W);
+}
+
+}  // extern "C"
+
+// ---------------------------------------------------------------------------
+// Fused stage handlers: one C call per worker batch per stage.
+//
+// The Python handlers originally assembled each batch with ~30 small numpy
+// ops; at the live system's batch sizes (tens of lanes) that fixed
+// dispatch cost measured ~1ms per batch chain — 30us/request — while the
+// marginal per-lane cost is ~1us.  These entry points fuse coalescing, the
+// state transition, and the host-mirror updates (max-ballot seen,
+// accept watermarks, last-active) into one call; the mirror arrays are the
+// manager's numpy buffers passed by pointer.
+// ---------------------------------------------------------------------------
+
+namespace {
+
+// open-addressing scratch map (key -> payload i64), per call
+struct Scratch {
+  uint64_t* keys;
+  int64_t* vals;
+  int64_t cap;
+  uint64_t mask;
+};
+
+bool scratch_init(Scratch* s, int64_t n) {
+  int64_t cap = 16;
+  while (cap < n * 2) cap <<= 1;
+  s->keys = (uint64_t*)std::malloc(cap * 8);
+  s->vals = (int64_t*)std::malloc(cap * 8);
+  s->cap = cap;
+  s->mask = (uint64_t)cap - 1;
+  if (!s->keys || !s->vals) { std::free(s->keys); std::free(s->vals);
+                              return false; }
+  for (int64_t i = 0; i < cap; ++i) s->vals[i] = -1;
+  return true;
+}
+
+void scratch_free(Scratch* s) { std::free(s->keys); std::free(s->vals); }
+
+inline uint64_t hmix(uint64_t h) {
+  h ^= h >> 33; h *= 0xff51afd7ed558ccdULL; h ^= h >> 33;
+  return h;
+}
+
+// find slot for key; *found set if occupied
+inline uint64_t scratch_find(Scratch* s, uint64_t key, bool* found) {
+  uint64_t j = hmix(key) & s->mask;
+  while (s->vals[j] >= 0) {
+    if (s->keys[j] == key) { *found = true; return j; }
+    j = (j + 1) & s->mask;
+  }
+  *found = false;
+  return j;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Acceptor-side batch (ref PaxosPacketBatcher coalesce +
+// PaxosAcceptor.acceptAndUpdateBallot + the manager's mirrors).
+// keep[i]=0 for lanes coalesced away (no reply).  Updates bal_mirror
+// (max-ballot-seen), acc_hi/acc_ts (catch-up watermark), la (last
+// active) for acked lanes.  reply_bal[i] = accepted bal on ack, promised
+// bal on nack.  Returns number of acked lanes.
+int64_t gp_gs_handle_accepts(void* h, int64_t n, const int32_t* rows,
+                             const int32_t* slots, const int32_t* bals,
+                             const uint64_t* reqs, double now,
+                             int32_t* bal_mirror, int64_t* acc_hi,
+                             double* acc_ts, double* la, uint8_t* keep,
+                             uint8_t* acked, uint8_t* stale,
+                             uint8_t* out_window, int32_t* reply_bal) {
+  Store* s = (Store*)h;
+  const int32_t W = s->W;
+  Scratch sc;
+  if (!scratch_init(&sc, n)) return -1;
+  // coalesce (row,slot) -> max-ballot winning lane
+  for (int64_t i = 0; i < n; ++i) {
+    keep[i] = 0;
+    if (rows[i] < 0) continue;
+    const uint64_t key = ((uint64_t)(uint32_t)rows[i] << 32) |
+                         (uint64_t)(uint32_t)slots[i];
+    bool found;
+    const uint64_t j = scratch_find(&sc, key, &found);
+    if (!found) {
+      sc.keys[j] = key; sc.vals[j] = i; keep[i] = 1;
+    } else if (bals[i] > bals[sc.vals[j]]) {
+      keep[sc.vals[j]] = 0; keep[i] = 1; sc.vals[j] = i;
+    }
+  }
+  scratch_free(&sc);
+  int64_t n_acked = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    acked[i] = stale[i] = out_window[i] = 0;
+    reply_bal[i] = kNoBallot;
+    if (!keep[i]) continue;
+    const int64_t r = rows[i];
+    if (r >= s->cap || !s->active[r]) { keep[i] = 0; continue; }
+    const int32_t slot = slots[i], bal = bals[i];
+    const int32_t cursor = s->exec_cursor[r];
+    const bool st = slot < cursor;
+    if (bal >= s->bal[r]) {
+      s->bal[r] = bal;
+    } else {
+      stale[i] = st;
+      reply_bal[i] = s->bal[r];
+      continue;  // nack (still replies)
+    }
+    reply_bal[i] = bal;
+    la[r] = now;
+    if (st) { acked[i] = 1; stale[i] = 1; }
+    else if (slot >= cursor + W) { out_window[i] = 1; continue; }
+    else {
+      const int64_t w = r * W + (slot % W);
+      s->acc_slot[w] = slot;
+      s->acc_bal[w] = bal;
+      s->acc_req[w] = reqs[i];
+      acked[i] = 1;
+    }
+    // mirrors (acked lanes only, matching the Python handler)
+    if (bal > bal_mirror[r]) bal_mirror[r] = bal;
+    if ((int64_t)slot > acc_hi[r]) acc_hi[r] = slot;
+    acc_ts[r] = now;
+    ++n_acked;
+  }
+  return n_acked;
+}
+
+// Coordinator-side accept replies (ref PaxosCoordinator.handleAcceptReply
+// + manager dedupe + member-index resolution).  member_mat is the
+// manager's [cap, maxm] i32 matrix (-1 padded).  newly[i]=1 lanes carry
+// dec_req/dec_bal.  Updates bal_mirror on preemption.  Returns count of
+// newly-decided lanes.
+int64_t gp_gs_handle_replies(void* h, int64_t n, const int32_t* rows,
+                             const int32_t* slots, const int32_t* bals,
+                             const int32_t* senders,
+                             const uint8_t* ack_flags,
+                             const int32_t* member_mat, int32_t maxm,
+                             int32_t* bal_mirror, uint8_t* newly,
+                             uint64_t* dec_req, int32_t* dec_bal) {
+  Store* s = (Store*)h;
+  const int32_t W = s->W;
+  Scratch sc;
+  if (!scratch_init(&sc, n)) return -1;
+  int64_t n_newly = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    newly[i] = 0;
+    dec_req[i] = 0;
+    dec_bal[i] = kNoBallot;
+    const int64_t r = rows[i];
+    if (r < 0 || r >= s->cap || !s->active[r]) continue;
+    // sender -> member index
+    int32_t sidx = -1;
+    const int32_t* mm = member_mat + r * maxm;
+    for (int32_t m = 0; m < maxm; ++m)
+      if (mm[m] == senders[i]) { sidx = m; break; }
+    if (sidx < 0) continue;  // reply from a non-member: ignore
+    // dedupe (row, slot, sender)
+    const uint64_t key = ((uint64_t)(uint32_t)rows[i] << 40) ^
+                         ((uint64_t)(uint32_t)slots[i] << 8) ^
+                         (uint64_t)(uint32_t)sidx;
+    bool found;
+    const uint64_t j = scratch_find(&sc, key, &found);
+    if (found) continue;
+    sc.keys[j] = key; sc.vals[j] = i;
+    if (!ack_flags[i]) {
+      if (s->is_coord[r] && bals[i] > s->cbal[r]) {
+        s->is_coord[r] = 0;
+        s->coord_active[r] = 0;
+        if (bals[i] > bal_mirror[r]) bal_mirror[r] = bals[i];
+      }
+      continue;
+    }
+    if (!(s->is_coord[r] && s->coord_active[r] && bals[i] == s->cbal[r]))
+      continue;
+    const int32_t slot = slots[i];
+    const int64_t w = r * W + (slot % W);
+    if (s->vote_slot[w] != slot) continue;
+    s->votes[w] |= (uint64_t)1 << (sidx & 63);
+    const int32_t maj = s->members[r] / 2 + 1;
+    if (popcount64(s->votes[w]) >= maj && !s->emitted[w]) {
+      s->emitted[w] = 1;
+      newly[i] = 1;
+      dec_req[i] = s->prop_req[w];
+      dec_bal[i] = s->cbal[r];
+      ++n_newly;
+    }
+  }
+  scratch_free(&sc);
+  return n_newly;
+}
+
+// Replica-side commits (decision install + in-order frontier): dedupe
+// keep-LAST per (row,slot), apply, update mirrors, and emit the newly
+// contiguous execution list (exec_rows/exec_slots/exec_reqs, capacity
+// n*W) that the Python side feeds to app.execute in order.  applied /
+// stale / out_window report per-lane outcomes (stale lanes also land in
+// the install set so retransmitted decisions re-serve sync).  Returns
+// exec list length, or -1 on alloc failure.
+int64_t gp_gs_handle_commits(void* h, int64_t n, const int32_t* rows,
+                             const int32_t* slots, const int32_t* bals,
+                             const uint64_t* reqs, double now,
+                             int32_t* bal_mirror, double* la,
+                             uint8_t* applied, uint8_t* stale,
+                             uint8_t* out_window, int32_t* exec_rows,
+                             int32_t* exec_slots, uint64_t* exec_reqs,
+                             int64_t exec_cap) {
+  Store* s = (Store*)h;
+  const int32_t W = s->W;
+  Scratch sc;
+  if (!scratch_init(&sc, n)) return -1;
+  // keep-last dedupe: later lanes overwrite earlier ones
+  for (int64_t i = 0; i < n; ++i) {
+    applied[i] = stale[i] = out_window[i] = 0;
+    const int64_t r = rows[i];
+    if (r < 0 || r >= s->cap || !s->active[r]) continue;
+    if (bals[i] > bal_mirror[r]) bal_mirror[r] = bals[i];
+    const uint64_t key = ((uint64_t)(uint32_t)rows[i] << 32) |
+                         (uint64_t)(uint32_t)slots[i];
+    bool found;
+    const uint64_t j = scratch_find(&sc, key, &found);
+    sc.keys[j] = key;
+    sc.vals[j] = i;  // last occurrence wins
+  }
+  // apply winners; track touched rows' pre-cursor via a second pass list
+  int64_t n_exec = 0;
+  for (uint64_t j = 0; j < (uint64_t)sc.cap; ++j) {
+    const int64_t i = sc.vals[j];
+    if (i < 0) continue;
+    const int64_t r = rows[i];
+    const int32_t slot = slots[i];
+    const int32_t pre = s->exec_cursor[r];
+    la[r] = now;
+    if (slot < pre) { stale[i] = 1; continue; }
+    if (slot >= pre + W) { out_window[i] = 1; continue; }
+    const int64_t base = r * W;
+    s->dec_slot[base + slot % W] = slot;
+    s->dec_req[base + slot % W] = reqs[i];
+    applied[i] = 1;
+  }
+  // frontier walk per touched row: emit newly contiguous decisions and
+  // advance the device cursor (exec_cursor is the DECIDED frontier; the
+  // app-executed frontier is the host's _cur, which lags on missing
+  // payloads and catches up via sync)
+  for (uint64_t j = 0; j < (uint64_t)sc.cap; ++j) {
+    const int64_t i = sc.vals[j];
+    if (i < 0 || !applied[i]) continue;
+    const int64_t r = rows[i];
+    const int64_t base = r * W;
+    int32_t cursor = s->exec_cursor[r];
+    while (s->dec_slot[base + cursor % W] == cursor) {
+      if (n_exec < exec_cap) {
+        exec_rows[n_exec] = (int32_t)r;
+        exec_slots[n_exec] = cursor;
+        exec_reqs[n_exec] = s->dec_req[base + cursor % W];
+        ++n_exec;
+      }
+      ++cursor;
+    }
+    s->exec_cursor[r] = cursor;
+  }
+  scratch_free(&sc);
+  return n_exec;
+}
+
+}  // extern "C"
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// WAL record batch encode (ref: SQLPaxosLogger batched logging): n records
+// -> one contiguous buffer in logger.py's _REC layout
+// [u8 rtype | u64 gkey | i32 slot | i32 bal | u64 req | u32 len | payload].
+// Returns bytes written or -1 if out_cap too small.
+// ---------------------------------------------------------------------------
+
+int64_t gp_encode_wal(int64_t n, const uint8_t* rtype, const uint64_t* gkey,
+                      const int32_t* slot, const int32_t* bal,
+                      const uint64_t* req, const int64_t* pay_off,
+                      const uint8_t* pay, uint8_t* out, int64_t out_cap) {
+  int64_t w = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    const int64_t plen = pay_off[i + 1] - pay_off[i];
+    if (w + 29 + plen > out_cap) return -1;
+    out[w] = rtype[i];
+    std::memcpy(out + w + 1, &gkey[i], 8);
+    std::memcpy(out + w + 9, &slot[i], 4);
+    std::memcpy(out + w + 13, &bal[i], 4);
+    std::memcpy(out + w + 17, &req[i], 8);
+    const uint32_t pl32 = (uint32_t)plen;
+    std::memcpy(out + w + 25, &pl32, 4);
+    std::memcpy(out + w + 29, pay + pay_off[i], plen);
+    w += 29 + plen;
+  }
+  return w;
+}
+
+}  // extern "C"

@@ -86,11 +86,19 @@ class Transport:
                  max_queue_bytes: int = 32 * 1024 * 1024,
                  ssl_server: Optional[ssl_mod.SSLContext] = None,
                  ssl_client: Optional[ssl_mod.SSLContext] = None,
-                 reconnect_base_s: float = 0.05):
+                 reconnect_base_s: float = 0.05,
+                 on_frames: Optional[Callable[[list], None]] = None):
         self.id = node_id
         self.listen_addr = listen_addr
         self.addr_map = dict(addr_map)
         self.on_frame = on_frame
+        # batch delivery: one callback per read chunk instead of one per
+        # frame (a queue hand-off per frame measured ~1us + a wakeup each
+        # on the 1-core host; a chunk carries tens of frames under load)
+        self.on_frames = on_frames
+        # steady-state sends go straight into the asyncio transport
+        # buffer, skipping the per-peer queue+task hop
+        self.direct_write = True
         self.max_queue_bytes = max_queue_bytes
         self.ssl_server = ssl_server
         self.ssl_client = ssl_client
@@ -176,6 +184,20 @@ class Transport:
             if peer is None:
                 peer = self._peers[dst] = _Peer()
                 peer.task = self._loop.create_task(self._writer_loop(dst))
+            if peer.writer is not None and not peer.queue and \
+                    self.direct_write:
+                # connected steady state: write straight into the asyncio
+                # transport buffer (the queue+writer-task hop costs a
+                # task wake per batch); backpressure via the transport's
+                # own write buffer against the same byte budget
+                w = peer.writer
+                if w.transport.get_write_buffer_size() + len(payload) > \
+                        self.max_queue_bytes:
+                    self.dropped_frames += nframes
+                    DelayProfiler.update_rate("net.drop")
+                    return False
+                self._write(w, payload, preframed, nframes)
+                return True
             if peer.bytes_queued + len(payload) > self.max_queue_bytes:
                 # a pre-framed batch drops as a unit (paxos tolerates
                 # loss; clients retransmit) — account every frame in it
@@ -207,6 +229,17 @@ class Transport:
     def send_raw_threadsafe(self, dst: int, buf: bytes,
                             nframes: int) -> None:
         self._loop.call_soon_threadsafe(self.send_raw, dst, buf, nframes)
+
+    def send_many(self, items: list) -> None:
+        """Enqueue ``[(dst, payload, preframed, nframes), ...]`` — ONE
+        loop hop for a whole worker batch's sends (each
+        ``call_soon_threadsafe`` writes the loop's wake pipe; a worker
+        batch fans out to several destinations)."""
+        for dst, payload, preframed, nframes in items:
+            self._enqueue(dst, payload, preframed, nframes)
+
+    def send_many_threadsafe(self, items: list) -> None:
+        self._loop.call_soon_threadsafe(self.send_many, items)
 
     def _write(self, w: asyncio.StreamWriter, payload: bytes,
                preframed: bool, nframes: int) -> None:
@@ -282,11 +315,21 @@ class Transport:
                 return
             buf += chunk
             offs, lens, consumed = native.scan_frames(buf)
-            for o, ln in zip(offs, lens):
-                o, ln = int(o), int(ln)
-                self.rcvd_frames += 1
-                self.rcvd_bytes += ln + 4
-                self._dispatch(bytes(memoryview(buf)[o:o + ln]))
+            if len(offs):
+                mv = memoryview(buf)
+                frames = [bytes(mv[int(o):int(o) + int(ln)])
+                          for o, ln in zip(offs, lens)]
+                del mv
+                self.rcvd_frames += len(frames)
+                self.rcvd_bytes += consumed
+                if self.on_frames is not None:
+                    try:
+                        self.on_frames(frames)
+                    except Exception:
+                        log.exception("batch handler failed")
+                else:
+                    for f in frames:
+                        self._dispatch(f)
             if consumed:
                 del buf[:consumed]
 

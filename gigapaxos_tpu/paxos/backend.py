@@ -318,6 +318,90 @@ class ScalarBackend(AcceptorBackend):
 
 
 # --------------------------------------------------------------------------
+# native backend (C++ per-instance engine)
+# --------------------------------------------------------------------------
+
+
+class NativeBackend(AcceptorBackend):
+    """C++ per-instance group store behind the same SPI
+    (``native/groupstore.cc``).
+
+    Role (SURVEY §2.6, §7.3.3): the reference's per-instance hot path is
+    JIT'd Java; a CPython loop is an unfair stand-in for it.  This engine
+    is (a) the honest "per-instance Java-equivalent" baseline for the
+    >=10x TPU comparison in ``bench.py``, and (b) the node runtime's
+    low-latency path — per-call overhead is one ctypes call, no device
+    round trip, so trickle traffic doesn't pay the columnar dispatch tax.
+    Semantics are the ``ops.oracle`` state machine verbatim (property-
+    tested for parity in ``tests/test_native.py``).
+    """
+
+    def __init__(self, capacity: int, window: int = 16):
+        from gigapaxos_tpu.native import GroupStore
+        self.store = GroupStore(capacity, window)
+        self._window = window
+        self.capacity = capacity
+
+    @property
+    def window(self) -> int:
+        return self._window
+
+    def create(self, rows, members, versions, init_bal, self_coord):
+        self.store.create(rows, members, versions, init_bal, self_coord)
+
+    def delete(self, rows):
+        self.store.delete(rows)
+
+    def accept(self, rows, slots, bals, req_ids) -> AcceptRes:
+        acked, stale, ow, cur = self.store.accept(rows, slots, bals,
+                                                  req_ids)
+        return AcceptRes(acked, stale, ow, cur)
+
+    def accept_reply(self, rows, slots, bals, senders, acked
+                     ) -> AcceptReplyRes:
+        newly, pre, dec_req, dec_bal = self.store.accept_reply(
+            rows, slots, bals, senders, acked)
+        lo, hi = _split64(dec_req)
+        return AcceptReplyRes(newly, pre, lo, hi, dec_bal)
+
+    def propose(self, rows, req_ids) -> ProposeRes:
+        status, slot, cbal = self.store.propose(rows, req_ids)
+        return ProposeRes(status == 0, status == 1, status == 2, slot,
+                          cbal)
+
+    def commit(self, rows, slots, req_ids) -> CommitRes:
+        applied, stale, ow, cur = self.store.commit(rows, slots, req_ids)
+        return CommitRes(applied, stale, ow, cur)
+
+    def prepare(self, rows, bals) -> PrepareRes:
+        acked, cur_bal, cursor, ws, wb, wreq = self.store.prepare(rows,
+                                                                  bals)
+        lo, hi = _split64(wreq.reshape(-1))
+        n = len(rows)
+        return PrepareRes(acked, cur_bal, cursor, ws, wb,
+                          lo.reshape(n, -1), hi.reshape(n, -1))
+
+    def install_coordinator(self, rows, cbals, next_slots, carry_slot,
+                            carry_req) -> None:
+        self.store.install(rows, cbals, next_slots, carry_slot, carry_req)
+
+    def set_cursor(self, rows, cursors, next_slots) -> None:
+        self.store.set_cursor(rows, cursors, next_slots)
+
+    def gc(self, rows, upto) -> None:
+        self.store.gc(rows, upto)
+
+    def cursor_of(self, row: int) -> int:
+        return self.store.cursor_of(row)
+
+    def snapshot_row(self, row: int) -> dict:
+        return self.store.snapshot_row(int(row))
+
+    def restore_row(self, row: int, snap: dict) -> None:
+        self.store.restore_row(int(row), snap)
+
+
+# --------------------------------------------------------------------------
 # columnar backend (the TPU data plane)
 # --------------------------------------------------------------------------
 

@@ -38,11 +38,11 @@ import numpy as np
 
 from gigapaxos_tpu import native
 from gigapaxos_tpu.net.transport import Transport
-from gigapaxos_tpu.ops.types import (NO_BALLOT, NO_SLOT, pack_ballot,
-                                     unpack_ballot)
+from gigapaxos_tpu.ops.types import (NODE_MASK, NO_BALLOT, NO_SLOT,
+                                     pack_ballot, unpack_ballot)
 from gigapaxos_tpu.paxos import packets as pkt
 from gigapaxos_tpu.paxos.backend import (AcceptorBackend, ColumnarBackend,
-                                         ScalarBackend)
+                                         NativeBackend, ScalarBackend)
 from gigapaxos_tpu.paxos.grouptable import GroupTable
 from gigapaxos_tpu.paxos.interfaces import Replicable
 from gigapaxos_tpu.paxos.logger import (CheckpointRec, LogEntry, PaxosLogger,
@@ -79,6 +79,31 @@ class _InFlight:
     redriven: float
 
 
+class _ReqSoA:
+    """A whole wire batch of REQUEST frames as struct-of-arrays — the
+    native parse output carried intact into ``_handle_requests`` so the
+    entry path runs vectorized (building one ``pkt.Request`` object per
+    frame measured ~45us/request of pure Python at 12K req/s)."""
+
+    __slots__ = ("sender", "gkey", "req_id", "flags", "pay_off", "pay")
+
+    def __init__(self, sender, gkey, req_id, flags, pay_off, pay):
+        self.sender = sender
+        self.gkey = gkey
+        self.req_id = req_id
+        self.flags = flags
+        self.pay_off = pay_off
+        self.pay = pay
+
+    def payload(self, i: int) -> bytes:
+        return self.pay[self.pay_off[i]:self.pay_off[i + 1]]
+
+    def as_request(self, i: int) -> "pkt.Request":
+        return pkt.Request(int(self.sender[i]), int(self.gkey[i]),
+                           int(self.req_id[i]), int(self.flags[i]),
+                           self.payload(i))
+
+
 @dataclass
 class _Election:
     """Phase-1 bookkeeping at a would-be coordinator (host-side cold path;
@@ -107,21 +132,45 @@ class PaxosNode:
         cap = capacity or Config.get(PC.CAPACITY)
         win = window or Config.get(PC.WINDOW)
         bk = backend or Config.get(PC.BACKEND)
-        self.backend: AcceptorBackend = (
-            ColumnarBackend(cap, win) if bk == "columnar"
-            else ScalarBackend(win))
+        if bk == "columnar":
+            self.backend: AcceptorBackend = ColumnarBackend(cap, win)
+        elif bk == "native":
+            try:
+                self.backend = NativeBackend(cap, win)
+            except (RuntimeError, MemoryError):
+                log.warning("native backend unavailable; using scalar")
+                self.backend = ScalarBackend(win)
+        else:
+            self.backend = ScalarBackend(win)
+        # fused C stage handlers (native backend only): one C call per
+        # worker batch per stage, updating the numpy mirrors in place —
+        # the per-batch numpy assembly cost (~1ms/batch chain at small
+        # batch sizes) disappears
+        self._fused = self.backend.store \
+            if isinstance(self.backend, NativeBackend) else None
         self.table = GroupTable(cap)
         self.logger = PaxosLogger(logdir, sync=bool(Config.get(PC.SYNC_WAL)))
         self.batch_size = int(Config.get(PC.BATCH_SIZE))
         self.batch_timeout = float(Config.get(PC.BATCH_TIMEOUT_S))
+        self.batch_coalesce = float(Config.get(PC.BATCH_COALESCE_S))
+        self.batch_busy = int(Config.get(PC.BATCH_BUSY_ITEMS))
         self.checkpoint_interval = int(Config.get(PC.CHECKPOINT_INTERVAL))
 
         # host-side per-row mirrors (the cold scalar state the reference
-        # keeps in PaxosInstanceStateMachine fields)
-        self._bal_seen: Dict[int, int] = {}       # row -> max packed ballot
-        self._cursor: Dict[int, int] = {}         # row -> host exec cursor
+        # keeps in PaxosInstanceStateMachine fields).  Row-indexed numpy
+        # arrays, not dicts: the hot handlers update them for whole
+        # batches with one vectorized op (np.maximum.at / fancy index)
+        # instead of a dict hit per lane.
+        self._bal = np.full(cap, NO_BALLOT, np.int32)  # max packed ballot
+        self._cur = np.zeros(cap, np.int32)            # host exec cursor
+        self._ckpt = np.full(cap, -1, np.int32)        # last ckpt slot
         self._dec: Dict[int, Dict[int, int]] = {}  # row -> slot -> req_id
-        self._ckpt_slot: Dict[int, int] = {}      # row -> last ckpt slot
+        # membership matrix for vectorized member-index lookups (rows of
+        # -1 padding); MAXM bounds group size (the vote bitmap is u64
+        # anyway, and the reference's quorums are 3-7 wide)
+        self.MAXM = 8
+        self._member_mat = np.full((cap, self.MAXM), -1, np.int32)
+        self._row_gkey = np.zeros(cap, np.uint64)
         # req_id -> (flags, payload); popped at local execution
         # (§7.3.5).  Two generations: entries untouched for two GC
         # periods (never-decided requests) are dropped — see
@@ -154,33 +203,44 @@ class PaxosNode:
         # onward at most once per window — the second sighting parks it,
         # breaking forward cycles without a wire-format TTL.
         self._bounced: Dict[int, float] = {}
-        # row -> (highest slot this acceptor acked, last-accept ts).
-        # Catch-up trigger: accepted-but-undecided past the cursor for
-        # longer than a grace period means the commits were lost — with
-        # no later traffic there is no gap signal, so _tick pulls the
-        # missing decisions via _sync_if_gap (ref: SyncDecisionsPacket).
-        self._acc_high: Dict[int, Tuple[int, float]] = {}
+        # Highest slot this acceptor acked + last-accept ts, per row
+        # (-1 = none outstanding).  Catch-up trigger: accepted-but-
+        # undecided past the cursor for longer than a grace period means
+        # the commits were lost — with no later traffic there is no gap
+        # signal, so _tick pulls the missing decisions via _sync_if_gap
+        # (ref: SyncDecisionsPacket).
+        self._acc_hi = np.full(cap, -1, np.int64)
+        self._acc_ts = np.zeros(cap, np.float64)
         self._batch_t0 = 0.0  # set per worker batch (_process)
         # rows whose epoch-stop request has executed: the RSM is closed —
         # later decided slots are skipped and clients told to re-resolve
         # (ref: PaxosInstanceStateMachine stopped/final-state logic)
         self._group_stopped: Set[int] = set()
-        # recently executed req_ids with timestamps — practical at-most-once
-        # for client retransmits that cross a coordinator change (ref:
-        # GCConcurrentHashMap outstanding-request tables, time-GC'd)
-        self._executed_recent: Dict[int, float] = {}
+        # recently executed req_ids — practical at-most-once for client
+        # retransmits that cross a coordinator change (ref:
+        # GCConcurrentHashMap outstanding-request tables).  TWO
+        # GENERATIONS, not timestamps: a sweep that rebuilds a dict of
+        # minutes×rate entries on the worker thread stalls it for tens of
+        # ms at 30K+ req/s; a generation swap is O(1).  Membership =
+        # either generation; entries age out after one-to-two periods.
+        self._executed_recent: Dict[int, int] = {}
+        self._executed_old: Dict[int, int] = {}
         # req_id -> (status, response bytes) for executed requests: a
         # deduped retransmit is ANSWERED from here, never silently
         # dropped; status-4 (deterministic app failure) entries keep a
-        # retried failed request from re-executing in a new slot
+        # retried failed request from re-executing in a new slot.  Same
+        # two-generation lifetime as _executed_recent.
         self._resp_cache: Dict[int, Tuple[int, bytes]] = {}
+        self._resp_cache_old: Dict[int, Tuple[int, bytes]] = {}
         self._elections: Dict[int, _Election] = {}
 
         # deactivator (ref: DiskMap pause/unpause + HotRestoreInfo):
         # idle groups are serialized to the durable pause table and their
-        # device row freed; packets for a paused group unpause on demand
+        # device row freed; packets for a paused group unpause on demand.
+        # _la[row] = last-active ts; +inf marks a free (or unpausable)
+        # row so the idle sweep is one vectorized compare.
         self._paused: Set[int] = set()
-        self._last_active: Dict[int, float] = {}
+        self._la = np.full(cap, np.inf, np.float64)
         self.pause_idle_s = float(Config.get(PC.PAUSE_IDLE_S))
         self.pause_max_per_tick = int(Config.get(PC.PAUSE_MAX_PER_TICK))
 
@@ -198,9 +258,13 @@ class PaxosNode:
         self._inq: "queue_mod.Queue" = queue_mod.Queue()
         # batched client-response buffer, live only inside _process
         self._resp_out: Optional[Dict] = None
+        # batched outbound sends, live only inside _process: flushed as
+        # ONE loop hop per worker batch (send_many_threadsafe)
+        self._out_buf: Optional[List] = None
         self._stopping = False
         self.transport = Transport(
-            node_id, addr_map[node_id], addr_map, self._on_frame)
+            node_id, addr_map[node_id], addr_map, self._on_frame,
+            on_frames=self._on_frames)
         self._loop_thread: Optional[threading.Thread] = None
         self._worker_thread: Optional[threading.Thread] = None
         self._loop = None
@@ -289,6 +353,14 @@ class PaxosNode:
         path.  Returns how many were actually created (existing names
         skipped)."""
         metas = []
+        for name, members in items:
+            # validate BEFORE any mutation: a failure mid-batch after
+            # device scatter would leave groups visible without mirrors
+            if len(members) > self.MAXM:
+                raise ValueError(
+                    f"group {name!r}: {len(members)} members > "
+                    f"MAXM={self.MAXM} (vote bitmap / member matrix "
+                    "width)")
         try:
             for name, members in items:
                 if (self.table.by_name(name) is not None
@@ -316,12 +388,15 @@ class PaxosNode:
             np.asarray([c == self.id for c in coords]))
         now = time.time()
         for meta, bal in zip(metas, bals):
-            self._bal_seen[meta.row] = bal
-            self._cursor[meta.row] = 0
+            self._bal[meta.row] = bal
+            self._cur[meta.row] = 0
             self._dec[meta.row] = {}
-            self._ckpt_slot[meta.row] = -1
+            self._ckpt[meta.row] = -1
             # idle-from-birth groups must still be pause-eligible
-            self._last_active[meta.row] = now
+            self._la[meta.row] = now
+            self._member_mat[meta.row] = -1
+            self._member_mat[meta.row, :len(meta.members)] = meta.members
+            self._row_gkey[meta.row] = meta.gkey
             if initial_state:
                 self.app.restore(meta.name, initial_state)
         if durable:
@@ -358,9 +433,7 @@ class PaxosNode:
             np.asarray([m.row for m in metas], np.int32))
         for meta in metas:
             self.table.delete(meta.gkey)
-            for d in (self._bal_seen, self._cursor, self._dec,
-                      self._ckpt_slot, self._acc_high):
-                d.pop(meta.row, None)
+            self._reset_row(meta.row)
             self._elections.pop(meta.row, None)
             self._group_stopped.discard(meta.row)
         self.logger.delete_groups([m.gkey for m in metas])
@@ -399,8 +472,20 @@ class PaxosNode:
     # pause / unpause (ref: DiskMap + HotRestoreInfo, SURVEY §5)
     # ------------------------------------------------------------------
 
+    def _reset_row(self, row: int) -> None:
+        """Return a row's host mirrors to free-row defaults (delete/
+        pause)."""
+        self._bal[row] = NO_BALLOT
+        self._cur[row] = 0
+        self._ckpt[row] = -1
+        self._acc_hi[row] = -1
+        self._la[row] = np.inf
+        self._member_mat[row] = -1
+        self._row_gkey[row] = 0
+        self._dec.pop(row, None)
+
     def _touch(self, row: int) -> None:
-        self._last_active[row] = time.time()
+        self._la[row] = time.time()
 
     def _sweep_idle(self, now: float) -> int:
         """One deactivator sweep: pause up to pause_max_per_tick rows
@@ -409,12 +494,8 @@ class PaxosNode:
         if self.pause_idle_s <= 0:
             return 0
         cutoff = now - self.pause_idle_s
-        idle = []
-        for row, t in list(self._last_active.items()):
-            if t <= cutoff:
-                idle.append(row)
-                if len(idle) >= self.pause_max_per_tick:
-                    break
+        idle = np.flatnonzero(self._la <= cutoff)[
+            :self.pause_max_per_tick].tolist()
         return self._pause_rows(idle) if idle else 0
 
     def _pause_rows(self, rows: List[int]) -> int:
@@ -426,7 +507,7 @@ class PaxosNode:
         for row in rows:
             meta = self.table.by_row(row)
             if meta is None:
-                self._last_active.pop(row, None)
+                self._la[row] = np.inf
                 continue
             if (row in self._elections or self._dec.get(row)
                     or row in self._group_stopped
@@ -446,9 +527,9 @@ class PaxosNode:
                 "name": meta.name,
                 "members": list(meta.members),
                 "version": meta.version,
-                "cursor": self._cursor.get(row, 0),
-                "bal_seen": self._bal_seen.get(row, NO_BALLOT),
-                "ckpt_slot": self._ckpt_slot.get(row, -1),
+                "cursor": int(self._cur[row]),
+                "bal_seen": int(self._bal[row]),
+                "ckpt_slot": int(self._ckpt[row]),
                 "app": base64.b64encode(
                     self.app.checkpoint(meta.name)).decode(),
                 "snap": snap,
@@ -459,10 +540,7 @@ class PaxosNode:
             np.asarray([r for r, _ in eligible], np.int32))
         for row, meta in eligible:
             self.table.delete(meta.gkey)
-            for d in (self._bal_seen, self._cursor, self._dec,
-                      self._ckpt_slot, self._acc_high):
-                d.pop(row, None)
-            self._last_active.pop(row, None)
+            self._reset_row(row)
             self._paused.add(meta.gkey)
             # shed the app's resident state too — _maybe_unpause
             # restores it from the blob
@@ -503,9 +581,12 @@ class PaxosNode:
                       d["name"])
             return None
         self.backend.restore_row(meta.row, d["snap"])
-        self._cursor[meta.row] = d["cursor"]
-        self._bal_seen[meta.row] = d["bal_seen"]
-        self._ckpt_slot[meta.row] = d["ckpt_slot"]
+        self._cur[meta.row] = d["cursor"]
+        self._bal[meta.row] = d["bal_seen"]
+        self._ckpt[meta.row] = d["ckpt_slot"]
+        self._member_mat[meta.row] = -1
+        self._member_mat[meta.row, :len(meta.members)] = meta.members
+        self._row_gkey[meta.row] = meta.gkey
         self._dec[meta.row] = {}
         self.app.restore(d["name"], base64.b64decode(d["app"]))
         self.logger.delete_pause(gkey)
@@ -515,8 +596,7 @@ class PaxosNode:
         # the coordinator may have died while this group was cold — the
         # dead-node scan only covers hydrated rows, so re-check here
         now = time.time()
-        _num, coord = unpack_ballot(self._bal_seen.get(meta.row,
-                                                       NO_BALLOT))
+        _num, coord = unpack_ballot(int(self._bal[meta.row]))
         if coord >= 0 and coord != self.id and coord in self.addr_map:
             last = self._last_heard.get(coord,
                                         getattr(self, "_boot_ts", now))
@@ -554,6 +634,10 @@ class PaxosNode:
         batch there."""
         self._inq.put(frame)
 
+    def _on_frames(self, frames: List[bytes]) -> None:
+        """Batch intake: one queue hand-off per read chunk."""
+        self._inq.put(frames)
+
     def _decode_batch(self, batch: List) -> List:
         """Worker-side decode: raw frames -> packet objects.  REQUEST
         frames (the per-client-item hot type) go through the native SoA
@@ -561,6 +645,10 @@ class PaxosNode:
         out = []
         req_frames: List[bytes] = []
         for item in batch:
+            if isinstance(item, list):
+                # chunk of frames (batch intake): flatten inline
+                batch.extend(item)
+                continue
             if not isinstance(item, (bytes, bytearray, memoryview)):
                 out.append(item)  # self-routed object
             elif len(item) == 0:
@@ -580,13 +668,8 @@ class PaxosNode:
                     [0] + [len(f) for f in req_frames[:-1]],
                     dtype=np.int64)
                 lens = np.asarray([len(f) for f in req_frames], np.int64)
-                sender, gkey, req_id, flags, pay_off, pay = \
-                    native.parse_requests(buf, offs, lens)
-                out.extend(
-                    pkt.Request(int(sender[i]), int(gkey[i]),
-                                int(req_id[i]), int(flags[i]),
-                                pay[pay_off[i]:pay_off[i + 1]])
-                    for i in range(len(req_frames)))
+                out.append(_ReqSoA(*native.parse_requests(buf, offs,
+                                                          lens)))
             except ValueError:
                 # a malformed frame poisons the batch parse: fall back to
                 # per-frame decode, dropping only the bad ones
@@ -596,6 +679,16 @@ class PaxosNode:
                     except Exception:
                         log.exception("dropping malformed request frame")
         return out
+
+    def _was_executed(self, rid: int) -> bool:
+        """At-most-once membership across both dedupe generations."""
+        return rid in self._executed_recent or rid in self._executed_old
+
+    def _cached_resp(self, rid: int) -> Tuple[int, bytes]:
+        got = self._resp_cache.get(rid)
+        if got is None:
+            got = self._resp_cache_old.get(rid, (0, b""))
+        return got
 
     def _store_payload(self, req: int, flags: int, payload: bytes) -> None:
         """Keep the best copy: a real payload always beats a FLAG_MISSING
@@ -635,7 +728,11 @@ class PaxosNode:
                 self._resp_out.setdefault(dst, []).append(
                     (obj.gkey, obj.req_id, obj.status, obj.payload))
                 return
-            self.transport.send_threadsafe(dst, obj.encode())
+            if self._out_buf is not None:
+                # buffered: one loop hop flushes the whole worker batch
+                self._out_buf.append((dst, obj.encode(), False, 1))
+            else:
+                self.transport.send_threadsafe(dst, obj.encode())
         # else: recovery runs before sockets exist; peers re-sync later
 
     def _flush_responses(self) -> None:
@@ -649,13 +746,17 @@ class PaxosNode:
                 np.asarray([it[1] for it in items], np.uint64),
                 np.asarray([it[2] for it in items], np.uint8),
                 [it[3] for it in items])
-            self.transport.send_raw_threadsafe(dst, buf, len(items))
+            if self._out_buf is not None:
+                self._out_buf.append((dst, buf, True, len(items)))
+            else:
+                self.transport.send_raw_threadsafe(dst, buf, len(items))
 
     # ------------------------------------------------------------------
     # worker
     # ------------------------------------------------------------------
 
     def _worker_loop(self) -> None:
+        prev_items = 0
         while not self._stopping:
             try:
                 first = self._inq.get(timeout=self.batch_timeout)
@@ -664,8 +765,19 @@ class PaxosNode:
                 continue
             if first is None:
                 break
+            if prev_items >= self.batch_busy and self.batch_coalesce > 0:
+                # adaptive coalescing (SURVEY §7.3.3): under load, let
+                # the batch fill before draining — fixed per-call costs
+                # amortize over ~10x more lanes.  Trickle traffic skips
+                # this (prev batch small), keeping the latency path hot.
+                time.sleep(self.batch_coalesce)
             batch = [first]
-            while len(batch) < self.batch_size:
+            # the cap counts FRAMES, not queue items: with batched
+            # intake one item can be a whole read chunk, and an
+            # uncounted fill would build multi-second mega-batches that
+            # starve _tick (elections, re-drive, catch-up)
+            n_frames = len(first) if isinstance(first, list) else 1
+            while n_frames < self.batch_size:
                 try:
                     nxt = self._inq.get_nowait()
                 except queue_mod.Empty:
@@ -674,9 +786,19 @@ class PaxosNode:
                     self._stopping = True
                     break
                 batch.append(nxt)
+                n_frames += len(nxt) if isinstance(nxt, list) else 1
+            prev_items = n_frames
             t0 = time.monotonic()
+            c0 = time.thread_time()
             try:
-                self._process(self._decode_batch(batch))
+                decoded = self._decode_batch(batch)
+                t1 = time.monotonic()
+                c1 = time.thread_time()
+                DelayProfiler.update_total("w.decode", t0, len(batch),
+                                           cpu_t0=c0)
+                self._process(decoded)
+                DelayProfiler.update_total("w.process", t1, len(batch),
+                                           cpu_t0=c1)
             except Exception:
                 log.exception("worker batch failed (%d items)", len(batch))
             DelayProfiler.update_delay("node.batch", t0, len(batch))
@@ -722,8 +844,7 @@ class PaxosNode:
             for meta in list(self.table):
                 if meta.row in self._elections:
                     continue
-                coord = unpack_ballot(
-                    self._bal_seen.get(meta.row, NO_BALLOT))[1]
+                coord = unpack_ballot(int(self._bal[meta.row]))[1]
                 if coord in self._suspects:
                     self._run_if_next_in_line(meta, coord, now)
         # accept re-drive (ref: the coordinator's accept retransmitter):
@@ -739,7 +860,7 @@ class PaxosNode:
                 meta = self.table.by_row(fl.row)
                 if meta is None:
                     continue
-                bal = self._bal_seen.get(fl.row, NO_BALLOT)
+                bal = int(self._bal[fl.row])
                 if bal != fl.bal or unpack_ballot(bal)[1] != self.id:
                     # the regime changed since this slot was assigned:
                     # NEVER re-emit at a different ballot (the carryover
@@ -764,12 +885,13 @@ class PaxosNode:
         # catch-up: slots we acked an Accept for but never saw decided —
         # the commit was lost and nothing later will signal a gap; pull
         # the decisions (or a checkpoint) from the coordinator
-        if self._acc_high:
-            for row, (hi, ts) in list(self._acc_high.items()):
-                if self._cursor.get(row, 0) > hi:
-                    self._acc_high.pop(row, None)
-                elif now - ts > 0.5:
-                    self._sync_if_gap(row)
+        pend = np.flatnonzero(self._acc_hi >= 0)
+        if len(pend):
+            done = pend[self._cur[pend] > self._acc_hi[pend]]
+            self._acc_hi[done] = -1
+            for row in pend[(self._cur[pend] <= self._acc_hi[pend])
+                            & (now - self._acc_ts[pend] > 0.5)]:
+                self._sync_if_gap(int(row))
         # re-route proposals parked while leadership was unsettled
         if self._parked:
             for row in list(self._parked):
@@ -777,8 +899,7 @@ class PaxosNode:
                 if meta is None:
                     self._parked.pop(row, None)
                     continue
-                coord = unpack_ballot(
-                    self._bal_seen.get(row, NO_BALLOT))[1]
+                coord = unpack_ballot(int(self._bal[row]))[1]
                 if row not in self._elections and coord >= 0 and \
                         coord not in self._suspects:
                     self._flush_parked(row)
@@ -790,16 +911,17 @@ class PaxosNode:
         # deactivator pass (ref: PaxosManager's pause thread); batched:
         # one device gather + one pause txn per sweep
         self._sweep_idle(now)
-        # GC the dedupe + response-cache + waiter tables (time TTL)
-        if len(self._executed_recent) > 100000 or \
-                getattr(self, "_last_exec_gc", 0) + 30 < now:
+        # GC the dedupe + response-cache + waiter tables: O(1)
+        # generation swaps (a filtering rebuild at 30K+ req/s stalls the
+        # worker tens of ms — the very stall that triggers client
+        # retransmit avalanches)
+        if len(self._executed_recent) > 2_000_000 or \
+                getattr(self, "_last_exec_gc", 0) + 60 < now:
             self._last_exec_gc = now
-            cutoff = now - 60
-            self._executed_recent = {
-                r: t for r, t in self._executed_recent.items()
-                if t > cutoff}
-            self._resp_cache = {r: v for r, v in self._resp_cache.items()
-                                if r in self._executed_recent}
+            self._executed_old = self._executed_recent
+            self._executed_recent = {}
+            self._resp_cache_old = self._resp_cache
+            self._resp_cache = {}
             self._client_wait = {
                 r: w for r, w in self._client_wait.items()
                 if w[1] > now - 120}
@@ -819,18 +941,24 @@ class PaxosNode:
 
     def _process(self, batch: List) -> None:
         self._resp_out: Optional[Dict] = {}
+        self._out_buf: Optional[List] = []
         self._batch_t0 = time.time()  # app-retry sleep budget anchor
         try:
             self._process_inner(batch)
         finally:
             self._flush_responses()
+            out, self._out_buf = self._out_buf, None
+            if out and self._loop is not None:
+                self.transport.send_many_threadsafe(out)
 
     def _process_inner(self, batch: List) -> None:
         by_type: Dict[type, List] = {}
         for obj in batch:
             by_type.setdefault(type(obj), []).append(obj)
             s = getattr(obj, "sender", None)
-            if s is not None and s in self.addr_map:
+            # (_ReqSoA carries a sender *array*; its senders are clients,
+            # never peers, so liveness bookkeeping doesn't apply)
+            if type(s) is int and s in self.addr_map:
                 self._last_heard[s] = time.time()
                 self._suspects.discard(s)
 
@@ -866,7 +994,7 @@ class PaxosNode:
             if meta is not None:
                 self._route(o.sender, pkt.CheckpointReply(
                     self.id, meta.gkey,
-                    self._cursor.get(meta.row, 0) - 1,
+                    int(self._cur[meta.row]) - 1,
                     self.app.checkpoint(meta.name)))
         for o in by_type.pop(pkt.CheckpointReply, []):
             self._handle_checkpoint_reply(o)
@@ -881,17 +1009,36 @@ class PaxosNode:
         # hot path, pipeline order
         reqs = by_type.pop(pkt.Request, [])
         props = by_type.pop(pkt.Proposal, [])
-        if reqs or props:
-            self._handle_requests(reqs, props)
+        soas = by_type.pop(_ReqSoA, [])
+        if reqs or props or soas:
+            t0 = time.monotonic()
+            c0 = time.thread_time()
+            self._handle_requests(reqs, props, soas)
+            DelayProfiler.update_total(
+                "w.requests", t0,
+                len(reqs) + len(props) + sum(len(s.gkey) for s in soas),
+                cpu_t0=c0)
         accepts = by_type.pop(pkt.AcceptBatch, [])
         if accepts:
+            t0 = time.monotonic()
+            c0 = time.thread_time()
             self._handle_accepts(accepts)
+            DelayProfiler.update_total("w.accepts", t0, len(accepts),
+                                       cpu_t0=c0)
         replies = by_type.pop(pkt.AcceptReplyBatch, [])
         if replies:
+            t0 = time.monotonic()
+            c0 = time.thread_time()
             self._handle_accept_replies(replies)
+            DelayProfiler.update_total("w.replies", t0, len(replies),
+                                       cpu_t0=c0)
         commits = by_type.pop(pkt.CommitBatch, [])
         if commits:
+            t0 = time.monotonic()
+            c0 = time.thread_time()
             self._handle_commits(commits)
+            DelayProfiler.update_total("w.commits", t0, len(commits),
+                                       cpu_t0=c0)
         for t, objs in by_type.items():
             handlers = self._handlers.get(t)
             if not handlers:
@@ -938,7 +1085,55 @@ class PaxosNode:
         if live:
             self._handle_requests([], live)
 
-    def _handle_requests(self, reqs: List, props: List) -> None:
+    def _handle_requests(self, reqs: List, props: List,
+                         soas: Tuple = ()) -> None:
+        rows_parts: List[np.ndarray] = []
+        req_parts: List[np.ndarray] = []
+        flag_parts: List[int] = []
+        pay_parts: List[bytes] = []
+        now = time.time()
+        ex, exo = self._executed_recent, self._executed_old
+        # ---- vectorized client batches (the hot path: one _ReqSoA per
+        # wire read; per-lane Python is 3-4 dict ops) ----
+        for sb in soas:
+            rows = self._rows_for_keys(sb.gkey)
+            bal = self._bal[np.where(rows >= 0, rows, 0)]
+            coords = np.where((rows >= 0) & (bal >= 0),
+                              bal & NODE_MASK, -1)
+            mine = coords == self.id
+            slow = ~mine
+            if self._group_stopped:
+                for i in np.flatnonzero(mine):
+                    if int(rows[i]) in self._group_stopped:
+                        mine[i] = False
+                        slow[i] = True
+            if slow.any():
+                # unknown group / foreign coordinator / stopped row:
+                # legacy per-object path below handles each such lane
+                reqs = reqs + [sb.as_request(int(i))
+                               for i in np.flatnonzero(slow)]
+            po, snd, rid_arr = sb.pay_off, sb.sender, sb.req_id
+            keep: List[int] = []
+            for i in np.flatnonzero(mine).tolist():
+                rid = int(rid_arr[i])
+                if rid in ex or rid in exo:
+                    st_, rv = self._cached_resp(rid)
+                    self._route(int(snd[i]), pkt.Response(
+                        self.id, int(sb.gkey[i]), rid, st_, rv))
+                    continue
+                if rid in self._proposed:
+                    continue
+                self._client_wait[rid] = (int(snd[i]), now,
+                                          int(sb.gkey[i]))
+                keep.append(i)
+            if keep:
+                ka = np.asarray(keep, np.int64)
+                rows_parts.append(rows[ka])
+                req_parts.append(rid_arr[ka])
+                flag_parts.extend(sb.flags[ka].tolist())
+                pay_parts.extend(sb.pay[po[i]:po[i + 1]] for i in keep)
+        # ---- legacy per-object path (forwards, parked re-injections,
+        # and any slow lanes shunted from above) ----
         lanes: List[Tuple[int, int, int, bytes, int]] = []  # row,req,fl,pl,en
         for o in reqs:
             meta = self._lookup(o.gkey)
@@ -946,10 +1141,10 @@ class PaxosNode:
                 self._route(o.sender, pkt.Response(
                     self.id, o.gkey, o.req_id, 2, b""))
                 continue
-            if o.req_id in self._executed_recent:
+            if self._was_executed(o.req_id):
                 # retransmit of an executed request: answer from the
                 # response cache, never drop silently (at-most-once + reply)
-                st, rv = self._resp_cache.get(o.req_id, (0, b""))
+                st, rv = self._cached_resp(o.req_id)
                 self._route(o.sender, pkt.Response(
                     self.id, o.gkey, o.req_id, st, rv))
                 continue
@@ -958,7 +1153,7 @@ class PaxosNode:
                     self.id, o.gkey, o.req_id, 3, b""))
                 continue
             self._client_wait[o.req_id] = (o.sender, time.time(), o.gkey)
-            coord = unpack_ballot(self._bal_seen[meta.row])[1]
+            coord = unpack_ballot(int(self._bal[meta.row]))[1]
             if coord != self.id:
                 prop = pkt.Proposal(
                     self.id, o.gkey, o.req_id, o.sender, o.flags, o.payload)
@@ -985,10 +1180,10 @@ class PaxosNode:
                 self._route(o.sender, pkt.Response(
                     self.id, o.gkey, o.req_id, 2, b""))
                 continue
-            if o.req_id in self._executed_recent:
+            if self._was_executed(o.req_id):
                 # answer rides a Response to the entry replica, which
                 # relays it to the waiting client (see Response handler)
-                st, rv = self._resp_cache.get(o.req_id, (0, b""))
+                st, rv = self._cached_resp(o.req_id)
                 self._route(o.sender, pkt.Response(
                     self.id, o.gkey, o.req_id, st, rv))
                 continue
@@ -996,7 +1191,7 @@ class PaxosNode:
                 self._route(o.sender, pkt.Response(
                     self.id, o.gkey, o.req_id, 3, b""))
                 continue
-            coord = unpack_ballot(self._bal_seen[meta.row])[1]
+            coord = unpack_ballot(int(self._bal[meta.row]))[1]
             if coord != self.id:
                 # not us (stale forward): park while leadership is
                 # unsettled; otherwise bounce onward AT MOST once per
@@ -1028,54 +1223,66 @@ class PaxosNode:
             if o.req_id in self._proposed:
                 continue
             lanes.append((meta.row, o.req_id, o.flags, o.payload, o.entry))
-        if not lanes:
+        if lanes:
+            rows_parts.append(np.asarray([l[0] for l in lanes], np.int32))
+            req_parts.append(np.asarray([l[1] for l in lanes], np.uint64))
+            flag_parts.extend(l[2] for l in lanes)
+            pay_parts.extend(l[3] for l in lanes)
+        if not rows_parts:
             return
-        rows = np.asarray([l[0] for l in lanes], np.int32)
-        req_ids = np.asarray([l[1] for l in lanes], np.uint64)
-        now = time.time()
-        for row in set(int(r) for r in rows):
-            self._last_active[row] = now
+        rows = np.concatenate(rows_parts).astype(np.int32, copy=False)
+        req_ids = np.concatenate(req_parts)
+        self._la[rows] = now
         res = self.backend.propose(rows, req_ids)
-        for i, (row, req_id, flags, payload, entry) in enumerate(lanes):
-            if res.granted[i]:
-                self._proposed[req_id] = _InFlight(
-                    row, int(res.slot[i]),
-                    self._bal_seen.get(row, NO_BALLOT), now, now)
-                self._store_payload(req_id, flags, payload)
-            elif res.rejected[i]:
+        granted = np.asarray(res.granted)
+        bal_of = self._bal[rows]
+        slot_arr = np.asarray(res.slot)
+        for i in np.flatnonzero(granted).tolist():
+            rid = int(req_ids[i])
+            self._proposed[rid] = _InFlight(
+                int(rows[i]), int(slot_arr[i]), int(bal_of[i]), now, now)
+            self._store_payload(rid, int(flag_parts[i]),
+                                bytes(pay_parts[i]))
+        rej = np.asarray(res.rejected)
+        if rej.any():
+            for i in np.flatnonzero(rej):
                 # we believed we coordinate this group but the device
-                # disagrees (post-restart: coordinatorship is never assumed
-                # on recovery) — regain it via phase 1; the client's
-                # retransmit rides the new ballot
+                # disagrees (post-restart: coordinatorship is never
+                # assumed on recovery) — regain it via phase 1; the
+                # client's retransmit rides the new ballot
+                row = int(rows[i])
                 meta = self.table.by_row(row)
                 if meta is not None and unpack_ballot(
-                        self._bal_seen.get(row, NO_BALLOT))[1] == self.id:
+                        int(self._bal[row]))[1] == self.id:
                     self._start_election(row, meta)
-        self._emit_accepts(lanes, res)
+        self._emit_accepts(rows, req_ids, flag_parts, pay_parts, res)
 
-    def _emit_accepts(self, lanes, res) -> None:
-        """Granted lanes → AcceptBatch per member destination."""
-        by_dst: Dict[int, List[int]] = {}
-        metas = []
-        for i, (row, *_rest) in enumerate(lanes):
-            meta = self.table.by_row(row)
-            metas.append(meta)
-            if not res.granted[i] or meta is None:
+    def _emit_accepts(self, rows, req_ids, flags, payloads, res) -> None:
+        """Granted lanes → AcceptBatch per member destination (one mask
+        per dst over the membership matrix; gkeys come from the row->gkey
+        array, pinned u64 — a bare np.asarray of mixed int magnitudes
+        would promote to float64 and corrupt keys past 53 bits)."""
+        granted = np.asarray(res.granted)
+        if not granted.any():
+            return
+        gi = np.flatnonzero(granted)
+        rows_g = rows[gi]
+        gkeys = self._row_gkey[rows_g]
+        slots = np.asarray(res.slot)[gi].astype(np.int32)
+        cbals = np.asarray(res.cbal)[gi].astype(np.int32)
+        reqs_g = req_ids[gi]
+        lo = (reqs_g & np.uint64(0xFFFFFFFF)).astype(np.uint32).view(
+            np.int32)
+        hi = (reqs_g >> np.uint64(32)).astype(np.uint32).view(np.int32)
+        pls = [bytes([flags[i]]) + payloads[i] for i in gi.tolist()]
+        dsts = self._member_mat[rows_g]
+        for dst in np.unique(dsts):
+            if dst < 0:
                 continue
-            for m in meta.members:
-                by_dst.setdefault(m, []).append(i)
-        for dst, idxs in by_dst.items():
-            # NB: gkeys straddle 2^63, so the dtype must be pinned — a bare
-            # np.asarray promotes mixed int magnitudes to float64 and
-            # silently corrupts keys past the 53-bit mantissa
-            ab = pkt.AcceptBatch(
-                self.id,
-                np.asarray([metas[i].gkey for i in idxs], np.uint64),
-                np.asarray([int(res.slot[i]) for i in idxs], np.int32),
-                np.asarray([int(res.cbal[i]) for i in idxs], np.int32),
-                *_split_reqs([lanes[i][1] for i in idxs]),
-                payloads=[bytes([lanes[i][2]]) + lanes[i][3] for i in idxs])
-            self._route(dst, ab)
+            m = (dsts == dst).any(axis=1)
+            self._route(int(dst), pkt.AcceptBatch(
+                self.id, gkeys[m], slots[m], cbals[m], lo[m], hi[m],
+                payloads=[pls[k] for k in np.flatnonzero(m)]))
 
     # -- accepts (acceptor side) ---------------------------------------
 
@@ -1083,189 +1290,283 @@ class PaxosNode:
         # flatten + coalesce: one lane per (row, slot), max ballot wins.
         # gkey->row is ONE native batched lookup; the (row, slot) max-bal
         # winner mask is ONE native hash pass (ref: PaxosPacketBatcher).
+        # Everything per-lane below is vectorized numpy over the batch —
+        # the only Python-per-lane work left is the payload dict store.
         gkeys = np.concatenate([np.asarray(o.gkey, np.uint64)
                                 for o in objs])
         slots_all = np.concatenate([np.asarray(o.slot, np.int32)
                                     for o in objs])
         bals_all = np.concatenate([np.asarray(o.bal, np.int32)
                                    for o in objs])
+        reqs_all = np.concatenate([
+            _merge_req(o.req_lo, o.req_hi) for o in objs])
+        send_all = np.concatenate([
+            np.full(len(o.gkey), o.sender, np.int32) for o in objs])
         rows_all = self._rows_for_keys(gkeys)
+        if self._fused is not None:
+            now = time.time()
+            keep, acked_m, stale_m, ow_m, reply_bal = \
+                self._fused.handle_accepts(
+                    rows_all, slots_all, bals_all, reqs_all, now,
+                    self._bal, self._acc_hi, self._acc_ts, self._la)
+            ai = np.flatnonzero(acked_m)
+            pls = _lane_payloads(objs, ai)
+            blobs = []
+            for k, i in enumerate(ai.tolist()):
+                blob = pls[k]
+                flags, payload = (blob[0], bytes(blob[1:])) if blob \
+                    else (0, b"")
+                self._store_payload(int(reqs_all[i]), flags, payload)
+                blobs.append(blob if blob else b"\x00")
+            wal_buf = native.encode_wal(
+                np.full(len(ai), REC_ACCEPT, np.uint8), gkeys[ai],
+                slots_all[ai], bals_all[ai], reqs_all[ai], blobs) \
+                if len(ai) else None
+            in_reply = keep & ~ow_m
+            acked_u8 = acked_m.astype(np.uint8)
+            out = []
+            for dst in np.unique(send_all[in_reply]):
+                m = in_reply & (send_all == dst)
+                out.append((int(dst), pkt.AcceptReplyBatch(
+                    self.id, gkeys[m], slots_all[m], reply_bal[m],
+                    acked_u8[m])))
+            if wal_buf is not None:
+                # durability barrier: fsync before replies leave
+                self.logger.log_raw_inline(wal_buf, n_entries=len(ai))
+            for dst, arb in out:
+                self._route(dst, arb)
+            return
         keep = native.coalesce_max(rows_all, slots_all, bals_all)
         if not keep.any():
             return
-        # per-lane metadata for the kept lanes
-        lane_src: List[Tuple[int, int, bytes]] = []  # (sender, req, blob)
-        for o in objs:
-            pls = o.payloads or [b""] * len(o.gkey)
-            for j in range(len(o.gkey)):
-                lane_src.append((o.sender,
-                                 _join_req(int(o.req_lo[j]),
-                                           int(o.req_hi[j])), pls[j]))
         idxs = np.flatnonzero(keep)
         rows = rows_all[idxs]
         slots = slots_all[idxs]
         bals = bals_all[idxs]
-        req_ids = np.asarray([lane_src[i][1] for i in idxs], np.uint64)
+        req_ids = reqs_all[idxs]
+        senders = send_all[idxs]
         now = time.time()
-        for row in set(int(r) for r in rows):
-            self._last_active[row] = now
+        self._la[rows] = now
         res = self.backend.accept(rows, slots, bals, req_ids)
 
-        entries = []
-        for i, li in enumerate(idxs):
-            if not res.acked[i]:
-                continue
-            sender, req, blob = lane_src[li]
+        acked = np.asarray(res.acked)
+        arows = rows[acked]
+        # vectorized mirrors: catch-up watermark + max ballot seen
+        np.maximum.at(self._acc_hi, arows, slots[acked])
+        self._acc_ts[arows] = now
+        np.maximum.at(self._bal, arows, bals[acked])
+        # payload store (the one per-lane Python loop left: dict insert)
+        blobs: List[bytes] = []
+        ai = np.flatnonzero(acked)
+        pls = _lane_payloads(objs, idxs[ai])
+        for k, i in enumerate(ai):
+            blob = pls[k]
             flags, payload = (blob[0], bytes(blob[1:])) if blob \
                 else (0, b"")
-            row, bal = int(rows[i]), int(bals[i])
-            ah = self._acc_high.get(row)
-            self._acc_high[row] = (
-                max(int(slots[i]), ah[0]) if ah else int(slots[i]), now)
-            self._store_payload(req, flags, payload)
-            self._bal_seen[row] = max(self._bal_seen.get(row, NO_BALLOT),
-                                      bal)
-            entries.append(LogEntry(REC_ACCEPT, int(gkeys[li]),
-                                    int(slots[i]), bal, req,
-                                    bytes([flags]) + payload))
-        # durability barrier: fsync BEFORE replies leave (SURVEY §7.3.2)
-        if entries:
-            self.logger.log_batch(entries).result()
+            self._store_payload(int(req_ids[i]), flags, payload)
+            blobs.append(blob if blob else b"\x00")
+        # durability: fsync BEFORE replies leave (SURVEY §7.3.2).  The
+        # write happens inline on this (the only logging) thread — the
+        # writer-thread hand-off costs two GIL hops per batch and buys
+        # no additional group commit (see logger.log_raw_inline).
+        wal_buf = None
+        if len(ai):
+            wal_buf = native.encode_wal(
+                np.full(len(ai), REC_ACCEPT, np.uint8), gkeys[idxs[ai]],
+                slots[ai], bals[ai], req_ids[ai], blobs)
 
-        # group replies per coordinator sender
-        by_coord: Dict[int, List[int]] = {}
-        for i, li in enumerate(idxs):
-            if res.out_window[i]:
-                continue  # dropped; coordinator retries / window advances
-            by_coord.setdefault(lane_src[li][0], []).append(i)
-        for dst, iidx in by_coord.items():
-            arb = pkt.AcceptReplyBatch(
-                self.id,
-                np.asarray([gkeys[idxs[i]] for i in iidx], np.uint64),
-                np.asarray([slots[i] for i in iidx], np.int32),
-                np.asarray([int(bals[i]) if res.acked[i]
-                            else int(res.cur_bal[i]) for i in iidx],
-                           np.int32),
-                np.asarray([1 if res.acked[i] else 0 for i in iidx],
-                           np.uint8))
+        # group replies per coordinator sender (vectorized per dst)
+        in_reply = ~np.asarray(res.out_window)
+        reply_bal = np.where(acked, bals, np.asarray(res.cur_bal))
+        acked_u8 = acked.astype(np.uint8)
+        reply_gkeys = gkeys[idxs]
+        out = []
+        for dst in np.unique(senders[in_reply]):
+            m = in_reply & (senders == dst)
+            out.append((int(dst), pkt.AcceptReplyBatch(
+                self.id, reply_gkeys[m], slots[m],
+                reply_bal[m].astype(np.int32), acked_u8[m])))
+        if wal_buf is not None:
+            # the send barrier: nothing acked leaves before durability
+            self.logger.log_raw_inline(wal_buf, n_entries=len(ai))
+        for dst, arb in out:
             self._route(dst, arb)
 
     # -- accept replies (coordinator side) ------------------------------
 
     def _handle_accept_replies(self, objs: List) -> None:
-        all_rows = self._rows_for_keys(
-            np.concatenate([np.asarray(o.gkey, np.uint64) for o in objs]))
-        seen: Set[Tuple[int, int, int]] = set()
-        rows_l, slots_l, bals_l, senders_l, acked_l = [], [], [], [], []
-        pos = 0
-        for o in objs:
-            for j in range(len(o.gkey)):
-                row = int(all_rows[pos])
-                pos += 1
-                if row < 0:
+        gkeys = np.concatenate([np.asarray(o.gkey, np.uint64)
+                                for o in objs])
+        slots_a = np.concatenate([np.asarray(o.slot, np.int32)
+                                  for o in objs])
+        bals_a = np.concatenate([np.asarray(o.bal, np.int32)
+                                 for o in objs])
+        acked_a = np.concatenate([np.asarray(o.acked, np.uint8)
+                                  for o in objs])
+        send_a = np.concatenate([
+            np.full(len(o.gkey), o.sender, np.int32) for o in objs])
+        all_rows = self._rows_for_keys(gkeys)
+        if self._fused is not None:
+            newly, dec_req, dec_bal = self._fused.handle_replies(
+                all_rows, slots_a, bals_a, send_a, acked_a,
+                self._member_mat, self._bal)
+            if not newly.any():
+                return
+            self.n_decided += int(newly.sum())
+            nrows = all_rows[newly]
+            dreq = dec_req[newly]
+            cb_gkey = gkeys[newly]
+            cb_slot = slots_a[newly]
+            cb_bal = dec_bal[newly]
+            cb_rlo = (dreq & np.uint64(0xFFFFFFFF)).astype(
+                np.uint32).view(np.int32)
+            cb_rhi = (dreq >> np.uint64(32)).astype(np.uint32).view(
+                np.int32)
+            dsts = self._member_mat[nrows]
+            for dst in np.unique(dsts):
+                if dst < 0:
                     continue
-                key = (row, int(o.slot[j]), o.sender)
-                if key in seen:
-                    continue
-                seen.add(key)
-                meta = self.table.by_row(row)
-                rows_l.append(row)
-                slots_l.append(int(o.slot[j]))
-                bals_l.append(int(o.bal[j]))
-                senders_l.append(meta.members.index(o.sender)
-                                 if o.sender in meta.members else 0)
-                acked_l.append(bool(o.acked[j]))
-        if not rows_l:
+                m = (dsts == dst).any(axis=1)
+                self._route(int(dst), pkt.CommitBatch(
+                    self.id, cb_gkey[m], cb_slot[m], cb_bal[m],
+                    cb_rlo[m], cb_rhi[m]))
             return
-        res = self.backend.accept_reply(
-            np.asarray(rows_l, np.int32), np.asarray(slots_l, np.int32),
-            np.asarray(bals_l, np.int32), np.asarray(senders_l, np.int32),
-            np.asarray(acked_l))
+        # sender -> member index, vectorized over the membership matrix
+        mm = self._member_mat[np.where(all_rows >= 0, all_rows, 0)]
+        sender_hits = mm == send_a[:, None]
+        sidx = np.argmax(sender_hits, axis=1).astype(np.int32)
+        valid = (all_rows >= 0) & sender_hits.any(axis=1)
+        # dedupe (row, slot, sender): one u64 key per lane, np.unique
+        key = ((all_rows.astype(np.uint64) << np.uint64(40))
+               ^ (slots_a.astype(np.uint64) << np.uint64(8))
+               ^ sidx.astype(np.uint64))
+        _, first = np.unique(key[valid], return_index=True)
+        sel = np.flatnonzero(valid)[first]
+        if not len(sel):
+            return
+        rows = all_rows[sel]
+        slots = slots_a[sel]
+        bals = bals_a[sel]
+        res = self.backend.accept_reply(rows, slots, bals, sidx[sel],
+                                        acked_a[sel].astype(bool))
         # preemption: a higher ballot exists; adopt belief, stop leading
-        for i in range(len(rows_l)):
-            if res.preempted[i]:
-                self._bal_seen[rows_l[i]] = max(
-                    self._bal_seen.get(rows_l[i], NO_BALLOT), bals_l[i])
-        newly = [i for i in range(len(rows_l)) if res.newly_decided[i]]
-        if not newly:
+        pre = np.asarray(res.preempted)
+        np.maximum.at(self._bal, rows[pre], bals[pre])
+        newly = np.asarray(res.newly_decided)
+        if not newly.any():
             return
-        self.n_decided += len(newly)
-        # decisions → CommitBatch to each member (incl. self via loopback)
-        by_dst: Dict[int, List[int]] = {}
-        for i in newly:
-            meta = self.table.by_row(rows_l[i])
-            for m in meta.members:
-                by_dst.setdefault(m, []).append(i)
-        for dst, idxs in by_dst.items():
-            cb = pkt.CommitBatch(
-                self.id,
-                np.asarray([self.table.by_row(rows_l[i]).gkey
-                            for i in idxs], np.uint64),
-                np.asarray([slots_l[i] for i in idxs], np.int32),
-                np.asarray([int(res.dec_bal[i]) for i in idxs], np.int32),
-                np.asarray([int(res.req_lo[i]) for i in idxs], np.int32),
-                np.asarray([int(res.req_hi[i]) for i in idxs], np.int32))
-            self._route(dst, cb)
+        self.n_decided += int(newly.sum())
+        # decisions -> CommitBatch to each member (incl. self loopback);
+        # destinations come from the membership matrix, one mask per dst
+        nrows = rows[newly]
+        cb_gkey = gkeys[sel][newly]
+        cb_slot = slots[newly]
+        cb_bal = np.asarray(res.dec_bal)[newly].astype(np.int32)
+        cb_rlo = np.asarray(res.req_lo)[newly].astype(np.int32)
+        cb_rhi = np.asarray(res.req_hi)[newly].astype(np.int32)
+        dsts = self._member_mat[nrows]
+        for dst in np.unique(dsts):
+            if dst < 0:
+                continue
+            m = (dsts == dst).any(axis=1)
+            self._route(int(dst), pkt.CommitBatch(
+                self.id, cb_gkey[m], cb_slot[m], cb_bal[m], cb_rlo[m],
+                cb_rhi[m]))
 
     # -- commits → execution -------------------------------------------
 
     def _handle_commits(self, objs: List) -> None:
-        all_rows = self._rows_for_keys(
-            np.concatenate([np.asarray(o.gkey, np.uint64) for o in objs]))
-        ded: Dict[Tuple[int, int], int] = {}
-        pos = 0
-        for o in objs:
-            for j in range(len(o.gkey)):
-                row = int(all_rows[pos])
-                pos += 1
-                if row < 0:
-                    continue
-                req = _join_req(int(o.req_lo[j]), int(o.req_hi[j]))
-                ded[(row, int(o.slot[j]))] = req
-                self._bal_seen[row] = max(
-                    self._bal_seen.get(row, NO_BALLOT), int(o.bal[j]))
-        if not ded:
-            return
-        keys = list(ded.keys())
-        rows = np.asarray([k[0] for k in keys], np.int32)
-        slots = np.asarray([k[1] for k in keys], np.int32)
-        req_ids = np.asarray([ded[k] for k in keys], np.uint64)
+        gkeys = np.concatenate([np.asarray(o.gkey, np.uint64)
+                                for o in objs])
+        slots_a = np.concatenate([np.asarray(o.slot, np.int32)
+                                  for o in objs])
+        bals_a = np.concatenate([np.asarray(o.bal, np.int32)
+                                 for o in objs])
+        reqs_a = np.concatenate([
+            _merge_req(o.req_lo, o.req_hi) for o in objs])
+        all_rows = self._rows_for_keys(gkeys)
+        self._commit_install(all_rows, slots_a, bals_a, reqs_a, gkeys)
+
+    def _commit_install(self, rows, slots, bals, req_ids,
+                        gkeys) -> None:
+        """Shared decision-install path (commit batches + sync replies):
+        dedupe, apply, WAL, execute newly contiguous decisions, and sync
+        on out-of-window lanes.  Fused C path when the native engine is
+        active; numpy + backend SPI otherwise."""
         now = time.time()
-        for row in set(int(r) for r in rows):
-            self._last_active[row] = now
-        res = self.backend.commit(rows, slots, req_ids)
-        self.logger.log_batch(
-            [LogEntry(REC_DECIDE, self.table.by_row(k[0]).gkey, k[1], 0,
-                      ded[k]) for i, k in enumerate(keys)
-             if res.applied[i]])  # decisions need not block on fsync
-        for i, k in enumerate(keys):
-            row, slot = k
-            if res.applied[i] or res.stale[i]:
-                self._dec[row][slot] = ded[k]
+        if self._fused is not None:
+            applied, stale_m, ow_m, ex_rows, ex_slots, ex_reqs = \
+                self._fused.handle_commits(rows, slots, bals, req_ids,
+                                           now, self._bal, self._la)
+            if applied.any():
+                # decisions need not block on fsync (replies gate on the
+                # ACCEPT records; decisions are recoverable from peers)
+                self.logger.log_raw_inline(native.encode_wal(
+                    np.full(int(applied.sum()), REC_DECIDE, np.uint8),
+                    gkeys[applied], slots[applied],
+                    np.zeros(int(applied.sum()), np.int32),
+                    req_ids[applied], []), fsync=False,
+                    n_entries=int(applied.sum()))
+            dec = self._dec
+            for i in range(len(ex_rows)):
+                dec.setdefault(int(ex_rows[i]), {})[int(ex_slots[i])] = \
+                    int(ex_reqs[i])
+            for row in np.unique(ex_rows):
+                self._execute_row(int(row))
+            for i in np.flatnonzero(ow_m):
+                self._sync_if_gap(int(rows[i]))
+            return
+        live = rows >= 0
+        if not live.any():
+            return
+        np.maximum.at(self._bal, rows[live], bals[live])
+        # dedupe (row, slot) keep-LAST (later packets carry newer bal)
+        key = ((rows.astype(np.uint64) << np.uint64(32))
+               ^ slots.astype(np.uint64))
+        rev = key[live][::-1]
+        _, first_rev = np.unique(rev, return_index=True)
+        sel = np.flatnonzero(live)[len(rev) - 1 - first_rev]
+        rows_s = rows[sel]
+        slots_s = slots[sel]
+        reqs_s = req_ids[sel]
+        self._la[rows_s] = now
+        res = self.backend.commit(rows_s, slots_s, reqs_s)
+        applied = np.asarray(res.applied)
+        if applied.any():
+            self.logger.log_raw_inline(native.encode_wal(
+                np.full(int(applied.sum()), REC_DECIDE, np.uint8),
+                gkeys[sel][applied], slots_s[applied],
+                np.zeros(int(applied.sum()), np.int32), reqs_s[applied],
+                []), fsync=False, n_entries=int(applied.sum()))
+        install = applied | np.asarray(res.stale)
+        for i in np.flatnonzero(install):
+            self._dec.setdefault(int(rows_s[i]), {})[int(slots_s[i])] = \
+                int(reqs_s[i])
         # execute newly contiguous decisions per touched row
-        for row in {k[0] for k in keys}:
-            self._execute_row(row)
+        for row in np.unique(rows_s):
+            self._execute_row(int(row))
         # out-of-window commits: requeue once the window advances — here
         # simply re-enqueue; window advance is driven by this same path
-        for i, k in enumerate(keys):
-            if res.out_window[i]:
-                self._sync_if_gap(k[0])
+        for i in np.flatnonzero(np.asarray(res.out_window)):
+            self._sync_if_gap(int(rows_s[i]))
 
     def _execute_row(self, row: int) -> None:
         meta = self.table.by_row(row)
         if meta is None:
             return
-        cur = self._cursor.get(row, 0)
+        cur = int(self._cur[row])
         dec = self._dec[row]
         while cur in dec:
             req_id = dec[cur]
-            got = self._payload_get(req_id)
+            got = self._payload_pop(req_id)
             if got is None or (got[0] & FLAG_MISSING):
+                if got is not None:
+                    self._payloads[req_id] = got  # keep the placeholder
                 # we never saw the accept (gap): ask peers, stop here
                 self._sync_if_gap(row)
                 break
             dec.pop(cur)
-            flags, payload = self._payload_pop(req_id)
+            flags, payload = got
             status = 0
             if flags & FLAG_NOOP:
                 resp = b""
@@ -1317,17 +1618,17 @@ class PaxosNode:
                 # retryable in the next epoch — caching it would answer a
                 # retransmit with an empty "success", i.e. a silently
                 # lost write.
-                self._executed_recent[req_id] = time.time()
+                self._executed_recent[req_id] = 1
                 self._resp_cache[req_id] = (status, resp)
             waiter = self._client_wait.pop(req_id, None)
             if waiter is not None:
                 self._route(waiter[0], pkt.Response(
                     self.id, meta.gkey, req_id, status, resp))
             cur += 1
-        self._cursor[row] = cur
+        self._cur[row] = cur
         # (device cursor advances in the commit kernel; no set_cursor here)
         # checkpoint cut (ref: extractExecuteAndCheckpoint, every ~400)
-        last = self._ckpt_slot.get(row, -1)
+        last = int(self._ckpt[row])
         if cur - 1 - last >= self.checkpoint_interval:
             self._checkpoint_row(row, cur - 1)
 
@@ -1337,7 +1638,7 @@ class PaxosNode:
         self.logger.checkpoint(CheckpointRec(
             meta.gkey, meta.name, meta.version, meta.members, upto_slot,
             state))
-        self._ckpt_slot[row] = upto_slot
+        self._ckpt[row] = upto_slot
         self.backend.gc(np.asarray([row], np.int32),
                         np.asarray([upto_slot], np.int32))
 
@@ -1351,8 +1652,8 @@ class PaxosNode:
         last[row] = now
         self._last_sync = last
         meta = self.table.by_row(row)
-        cur = self._cursor.get(row, 0)
-        coord = unpack_ballot(self._bal_seen.get(row, NO_BALLOT))[1]
+        cur = int(self._cur[row])
+        coord = unpack_ballot(int(self._bal[row]))[1]
         dst = coord if (coord >= 0 and coord != self.id) else None
         if dst is None:
             others = [m for m in meta.members if m != self.id]
@@ -1377,10 +1678,10 @@ class PaxosNode:
         if not have:
             # decisions already executed & GC'd: catch the laggard up with
             # a whole-state checkpoint instead (ref: StatePacket path)
-            if self._cursor.get(row, 0) > o.from_slot:
+            if int(self._cur[row]) > o.from_slot:
                 state = self.app.checkpoint(meta.name)
                 self._route(o.sender, pkt.CheckpointReply(
-                    self.id, meta.gkey, self._cursor.get(row, 0) - 1,
+                    self.id, meta.gkey, int(self._cur[row]) - 1,
                     state))
             return
         pls = []
@@ -1408,13 +1709,13 @@ class PaxosNode:
         if not ded:
             return
         keys = list(ded.keys())
-        res = self.backend.commit(
+        n = len(keys)
+        self._commit_install(
             np.asarray([k[0] for k in keys], np.int32),
             np.asarray([k[1] for k in keys], np.int32),
-            np.asarray([ded[k] for k in keys], np.uint64))
-        for i, k in enumerate(keys):
-            if res.applied[i] or res.stale[i]:
-                self._dec[k[0]][k[1]] = ded[k]
+            np.zeros(n, np.int32),
+            np.asarray([ded[k] for k in keys], np.uint64),
+            np.full(n, o.gkey, np.uint64))
         self._execute_row(meta.row)
 
     def _handle_checkpoint_reply(self, o) -> None:
@@ -1424,19 +1725,19 @@ class PaxosNode:
         if meta is None:
             return
         row = meta.row
-        cur = self._cursor.get(row, 0)
+        cur = int(self._cur[row])
         if o.slot < cur:
             return  # stale: we are already past it
         self.app.restore(meta.name, o.state)
         newcur = o.slot + 1
-        self._cursor[row] = newcur
+        self._cur[row] = newcur
         d = self._dec.get(row, {})
         for s in [s for s in d if s < newcur]:
             self._payload_pop(d.pop(s))
         self.backend.set_cursor(np.asarray([row], np.int32),
                                 np.asarray([newcur], np.int32),
                                 np.asarray([newcur], np.int32))
-        self._ckpt_slot[row] = o.slot
+        self._ckpt[row] = o.slot
         self.logger.checkpoint(CheckpointRec(
             meta.gkey, meta.name, meta.version, meta.members, o.slot,
             o.state))
@@ -1461,7 +1762,7 @@ class PaxosNode:
         first live member after it in ring order, run phase 1 (ref:
         deterministic next-in-line from ballot/coordinator order)."""
         row = meta.row
-        bal = self._bal_seen.get(row, NO_BALLOT)
+        bal = int(self._bal[row])
         _num, coord = unpack_ballot(bal)
         if coord != dead or self.id not in meta.members:
             return
@@ -1480,7 +1781,7 @@ class PaxosNode:
             self._start_election(row, meta)
 
     def _start_election(self, row: int, meta) -> None:
-        num, _ = unpack_ballot(self._bal_seen.get(row, NO_BALLOT))
+        num, _ = unpack_ballot(int(self._bal[row]))
         el = self._elections.get(row)
         if el is not None and time.time() - el.started < 2.0:
             return
@@ -1507,8 +1808,8 @@ class PaxosNode:
         for i, row in enumerate(rows):
             bal, sender = best[row]
             meta = self.table.by_row(row)
-            self._bal_seen[row] = max(self._bal_seen.get(row, NO_BALLOT),
-                                      int(res.cur_bal[i]))
+            if int(res.cur_bal[i]) > self._bal[row]:
+                self._bal[row] = int(res.cur_bal[i])
             m = int(np.sum(res.win_slot[i] >= 0))
             slots = res.win_slot[i][:m] if m else np.zeros(0, np.int32)
             pls = []
@@ -1537,8 +1838,8 @@ class PaxosNode:
             return
         if not o.acked:
             if o.bal > el.bal:
-                self._bal_seen[row] = max(self._bal_seen.get(row, NO_BALLOT),
-                                          o.bal)
+                if o.bal > self._bal[row]:
+                    self._bal[row] = o.bal
                 del self._elections[row]
             return
         if o.bal != el.bal:
@@ -1567,7 +1868,7 @@ class PaxosNode:
         self._install_as_coordinator(row, meta, el)
 
     def _install_as_coordinator(self, row: int, meta, el: _Election) -> None:
-        cursor = max(el.cursor, self._cursor.get(row, 0))
+        cursor = max(el.cursor, int(self._cur[row]))
         carry = {s: v for s, v in el.merged.items() if s >= cursor}
         # fill payload-less carryovers from our own store when possible
         for s, (b, req, fl, pl) in list(carry.items()):
@@ -1591,7 +1892,7 @@ class PaxosNode:
         self.backend.install_coordinator(
             np.asarray([row], np.int32), np.asarray([el.bal], np.int32),
             np.asarray([next_slot], np.int32), cs, cr)
-        self._bal_seen[row] = el.bal
+        self._bal[row] = el.bal
         log.info("node %d now coordinator of %s at bal %d (carry %d)",
                  self.id, meta.name, el.bal, len(carry))
         # reconcile OUR in-flight proposals with the new regime: entries
@@ -1669,16 +1970,19 @@ class PaxosNode:
                 np.asarray([version], np.int32),
                 np.asarray([init_bal], np.int32),
                 np.asarray([False]))  # NEVER coordinator on restart until
-            self._bal_seen[meta.row] = init_bal  # re-elected (safe default)
-            self._cursor[meta.row] = 0
+            self._bal[meta.row] = init_bal  # re-elected (safe default)
+            self._cur[meta.row] = 0
             self._dec[meta.row] = {}
-            self._ckpt_slot[meta.row] = -1
-            self._last_active[meta.row] = t0  # pause-eligible when idle
+            self._ckpt[meta.row] = -1
+            self._la[meta.row] = t0  # pause-eligible when idle
+            self._member_mat[meta.row] = -1
+            self._member_mat[meta.row, :len(members)] = members
+            self._row_gkey[meta.row] = gkey
             rec = self.logger.get_checkpoint(gkey)
             if rec is not None and rec.slot >= 0:
                 self.app.restore(name, rec.state)
-                self._cursor[meta.row] = rec.slot + 1
-                self._ckpt_slot[meta.row] = rec.slot
+                self._cur[meta.row] = rec.slot + 1
+                self._ckpt[meta.row] = rec.slot
                 self.backend.set_cursor(
                     np.asarray([meta.row], np.int32),
                     np.asarray([rec.slot + 1], np.int32),
@@ -1700,8 +2004,8 @@ class PaxosNode:
                 if e.payload:
                     self._store_payload(
                         e.req_id, e.payload[0], bytes(e.payload[1:]))
-                self._bal_seen[meta.row] = max(
-                    self._bal_seen.get(meta.row, NO_BALLOT), e.bal)
+                if e.bal > self._bal[meta.row]:
+                    self._bal[meta.row] = e.bal
             else:
                 dec_by_row.setdefault(meta.row, {})[e.slot] = e.req_id
         if acc_rows:
@@ -1719,7 +2023,7 @@ class PaxosNode:
                            np.uint64))
             for i, (r, s) in enumerate(keys):
                 if res.applied[i] or res.stale[i]:
-                    if s >= self._cursor.get(r, 0):
+                    if s >= self._cur[r]:
                         self._dec[r][s] = dec_by_row[r][s]
             for r in dec_by_row:
                 self._execute_row(r)
@@ -1734,6 +2038,23 @@ def _np_jsonable(o):
     if isinstance(o, np.generic):
         return o.item()
     raise TypeError(f"not jsonable: {type(o)}")
+
+
+def _merge_req(lo, hi) -> np.ndarray:
+    """Vectorized (lo32, hi32) -> u64 request ids for a whole batch."""
+    lo = np.ascontiguousarray(lo, np.int32).view(np.uint32).astype(
+        np.uint64)
+    hi = np.ascontiguousarray(hi, np.int32).view(np.uint32).astype(
+        np.uint64)
+    return lo | (hi << np.uint64(32))
+
+
+def _lane_payloads(objs, sel) -> List[bytes]:
+    """Payload blobs of the selected global lanes across a packet list."""
+    all_pls: List[bytes] = []
+    for o in objs:
+        all_pls.extend(o.payloads or (b"",) * len(o.gkey))
+    return [all_pls[int(i)] for i in sel]
 
 
 def _split_reqs(reqs: List[int]) -> Tuple[np.ndarray, np.ndarray]:

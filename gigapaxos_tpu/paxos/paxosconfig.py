@@ -21,6 +21,13 @@ class PC(ConfigKey):
     BATCH_SIZE = 4096
     # batch-fill timeout: flush a partial batch after this many seconds
     BATCH_TIMEOUT_S = 0.002
+    # adaptive coalescing (SURVEY §7.3.3): when the previous batch had at
+    # least BATCH_BUSY_ITEMS items (load present), the worker naps
+    # BATCH_COALESCE_S after the first item of the next batch so the
+    # batch fills — per-call fixed costs amortize ~10x.  Trickle traffic
+    # (previous batch small) skips the nap: latency path stays hot.
+    BATCH_COALESCE_S = 0.003
+    BATCH_BUSY_ITEMS = 24
     # app checkpoint every this many slots per group (ref ~400)
     CHECKPOINT_INTERVAL = 400
     # backend: "columnar" (JAX/TPU) or "scalar" (per-instance baseline)

@@ -120,6 +120,28 @@ class PaxosEmulation:
 
     # -- load generation (ref: TESTPaxosClient) -------------------------
 
+    def run_load_fast(self, n_requests: int, concurrency: int = 512,
+                      payload: bytes = b"x", timeout: float = 30.0,
+                      client_id: int = 1 << 20) -> Dict:
+        """Windowed pipelined load (ref TESTPaxosClient; see
+        testing/loadgen.py) — the measurement path for the throughput
+        bench; ``run_load`` below is the per-request-client path used by
+        correctness tests."""
+        from gigapaxos_tpu.testing.loadgen import run_fast_load_sync
+        live = sorted(i for i, nd in self.nodes.items() if nd is not None)
+        servers = [self.addr_map[i] for i in live]
+        # route each group to its initial coordinator if alive
+        route = []
+        from gigapaxos_tpu.paxos.packets import group_key
+        for g in self.groups:
+            mem = self.members_of(g)
+            coord = mem[group_key(g) % len(mem)]
+            route.append(live.index(coord) if coord in live else 0)
+        return run_fast_load_sync(
+            servers, self.groups, n_requests, concurrency=concurrency,
+            payload=payload, client_id=client_id, timeout=timeout,
+            route=route)
+
     def run_load(self, n_requests: int, concurrency: int = 64,
                  payload: bytes = b"x", timeout: float = 15.0,
                  client_id: int = 1 << 20,

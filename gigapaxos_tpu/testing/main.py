@@ -34,9 +34,10 @@ def mode_throughput(args) -> dict:
                          capacity=args.capacity, window=args.window,
                          sync_wal=args.sync_wal)
     try:
-        emu.run_load(min(2000, args.requests // 10) or 100,
-                     concurrency=args.concurrency)  # warmup
-        stats = emu.run_load(args.requests, concurrency=args.concurrency)
+        emu.run_load_fast(min(2000, args.requests // 10) or 100,
+                          concurrency=args.concurrency)  # warmup
+        stats = emu.run_load_fast(args.requests,
+                                  concurrency=args.concurrency)
         return {
             "metric": f"e2e decided req/s, {args.nodes} replicas, "
                       f"{args.groups} groups ({args.backend})",
@@ -119,9 +120,9 @@ def main(argv=None) -> int:
     p.add_argument("--nodes", type=int, default=3)
     p.add_argument("--groups", type=int, default=1000)
     p.add_argument("--requests", type=int, default=20000)
-    p.add_argument("--concurrency", type=int, default=128)
+    p.add_argument("--concurrency", type=int, default=512)
     p.add_argument("--backend", default="columnar",
-                   choices=["columnar", "scalar"])
+                   choices=["columnar", "native", "scalar"])
     p.add_argument("--capacity", type=int, default=1 << 16)
     p.add_argument("--window", type=int, default=16)
     p.add_argument("--sync-wal", action="store_true")

@@ -11,7 +11,7 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import Dict
+from typing import Dict, Optional
 
 
 class _EWMA:
@@ -53,7 +53,33 @@ class DelayProfiler:
     _delays: Dict[str, _EWMA] = {}
     _values: Dict[str, _EWMA] = {}
     _rates: Dict[str, _Rate] = {}
+    _totals: Dict[str, list] = {}  # tag -> [seconds, calls, items]
     enabled: bool = True
+
+    @classmethod
+    def update_total(cls, tag: str, t0: float, n: int = 1,
+                     cpu_t0: Optional[float] = None) -> None:
+        """Accumulate wall seconds + item count under ``tag`` — the
+        where-does-the-core-go view (EWMAs show per-batch shape, totals
+        show the budget split).  Pass ``cpu_t0`` (from
+        ``time.thread_time()``) to also accumulate true CPU seconds —
+        on a saturated 1-core host, wall inside a stage is mostly GIL
+        wait and lies about the budget."""
+        if not cls.enabled:
+            return
+        dt = time.monotonic() - t0
+        dcpu = (time.thread_time() - cpu_t0) if cpu_t0 is not None else 0.0
+        with cls._lock:
+            t = cls._totals.setdefault(tag, [0.0, 0, 0, 0.0])
+            t[0] += dt
+            t[1] += 1
+            t[2] += n
+            t[3] += dcpu
+
+    @classmethod
+    def totals(cls) -> Dict[str, tuple]:
+        with cls._lock:
+            return {k: tuple(v) for k, v in cls._totals.items()}
 
     @classmethod
     def update_delay(cls, tag: str, t0: float, n: int = 1) -> None:
@@ -99,6 +125,9 @@ class DelayProfiler:
                 parts.append(f"{tag}={e.value:.3f}[{e.count}]")
             for tag, r in sorted(cls._rates.items()):
                 parts.append(f"{tag}={r.per_sec:.1f}/s[{r.count}]")
+            for tag, t in sorted(cls._totals.items()):
+                parts.append(
+                    f"{tag}={t[0]:.2f}s/{t[3]:.2f}cpu[{t[1]}c/{t[2]}i]")
             return " ".join(parts)
 
     @classmethod
@@ -107,3 +136,4 @@ class DelayProfiler:
             cls._delays.clear()
             cls._values.clear()
             cls._rates.clear()
+            cls._totals.clear()

@@ -49,7 +49,7 @@ def test_coordinator_failover(tmp_path):
         # a survivor holds a ballot with a new coordinator
         live = [nd for i, nd in enumerate(nodes) if i != dead]
         row = live[0].table.by_name(name).row
-        num, coord = unpack_ballot(live[0]._bal_seen[row])
+        num, coord = unpack_ballot(int(live[0]._bal[row]))
         assert coord != dead and num >= 1
         # safety: survivors agree on count/digest
         deadline = time.time() + 10
@@ -104,7 +104,7 @@ def test_failover_under_message_loss(tmp_path):
         assert done >= 10, f"only {done}/10 decided under loss"
         live = [nd for i, nd in enumerate(nodes) if i != dead]
         row = live[0].table.by_name(name).row
-        _num, coord = unpack_ballot(live[0]._bal_seen[row])
+        _num, coord = unpack_ballot(int(live[0]._bal[row]))
         assert coord != dead
         # safety: stop the chaos, let commits settle, digests must agree
         for nd in live:
