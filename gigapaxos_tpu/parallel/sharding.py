@@ -8,10 +8,14 @@ ops plus the minimal ICI collectives — no hand-written collective calls,
 exactly the pjit recipe (scaling-book style: pick a mesh, annotate
 shardings, let XLA insert collectives).
 
-The batch→shard routing that a production multi-chip deployment would do
-on the host (bucket packet lanes by ``row // rows_per_shard``) is
-deliberately NOT needed for correctness here — XLA masks out-of-shard
-lanes — it is a later throughput optimization.
+This module is used by BOTH the storm kernel (``make_sharded_storm``,
+the driver dryrun) and the node runtime: ``ColumnarBackend`` auto-shards
+its state over all local devices (``PC.COLUMNAR_MESH = "auto"``), so the
+e2e/failover suites on the virtual 8-CPU mesh run the sharded path end
+to end.  Host-side batch→shard routing (bucket packet lanes by
+``row // rows_per_shard``) is NOT needed for correctness — XLA masks
+out-of-shard lanes — and remains a future throughput optimization for
+real multi-chip topologies.
 """
 
 from __future__ import annotations

@@ -424,7 +424,8 @@ class ColumnarBackend(AcceptorBackend):
     """
 
     def __init__(self, capacity: int, window: int = 16,
-                 use_pallas_accept: Optional[bool] = None):
+                 use_pallas_accept: Optional[bool] = None,
+                 mesh=None):
         import jax
         from gigapaxos_tpu.ops import kernels, make_state
         self._jax = jax
@@ -432,6 +433,46 @@ class ColumnarBackend(AcceptorBackend):
         self.state = make_state(capacity, window)
         self._window = window
         self.capacity = capacity
+        # group-axis sharding over a device mesh (SURVEY §2.7): state
+        # lives sharded; batch inputs are replicated; XLA SPMD turns the
+        # row gathers/scatters into shard-local ops + ICI collectives.
+        # "auto" shards across all local devices when there are >1 —
+        # which includes the test env's virtual 8-CPU mesh, so the e2e
+        # suites exercise this path, not just the storm dryrun.
+        from gigapaxos_tpu.utils.config import Config as _Cfg
+        from gigapaxos_tpu.paxos.paxosconfig import PC as _PC
+        self._mesh = mesh
+        self._repl = None
+        # runtime device pinning (PC.COLUMNAR_DEVICE): the node runtime
+        # defaults to host XLA — per-batch calls pay a host<->device
+        # round trip each, which over a remote/tunneled accelerator
+        # costs more than the kernel itself
+        devs = jax.local_devices()
+        pinned = False
+        if str(_Cfg.get(_PC.COLUMNAR_DEVICE)) == "cpu" and \
+                jax.default_backend() != "cpu":
+            try:
+                devs = jax.local_devices(backend="cpu")
+                pinned = True
+            except RuntimeError:
+                pass  # no cpu backend registered: stay on default
+        if self._mesh is None and \
+                str(_Cfg.get(_PC.COLUMNAR_MESH)) == "auto" and \
+                len(devs) > 1 and capacity % len(devs) == 0:
+            from jax.sharding import Mesh
+            self._mesh = Mesh(np.asarray(devs), ("groups",))
+        if self._mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+            ns = NamedSharding(self._mesh, PartitionSpec("groups"))
+            self.state = jax.device_put(
+                self.state,
+                jax.tree_util.tree_map(lambda _: ns, self.state))
+            self._repl = NamedSharding(self._mesh, PartitionSpec())
+            use_pallas_accept = False  # Mosaic path is single-device
+        elif pinned:
+            # single-device pin: host XLA next to a remote accelerator
+            self.state = jax.device_put(self.state, devs[0])
+            self._repl = devs[0]
         # fused Pallas accept path (ops/pallas_accept.py): opt-in via
         # arg or PC.USE_PALLAS_ACCEPT; one probe call decides — Mosaic
         # constraints or a CPU-only build fall back to the XLA scatters
@@ -465,20 +506,26 @@ class ColumnarBackend(AcceptorBackend):
 
     # -- padding helpers ---------------------------------------------------
 
-    def _pad1(self, arr, fill, dtype=np.int32):
+    def _dev(self, arr):
+        """Host array -> device; replicated over the mesh when sharded
+        (batch lanes are the replicated axis of SURVEY §2.7)."""
+        if self._repl is not None:
+            return self._jax.device_put(arr, self._repl)
         import jax.numpy as jnp
+        return jnp.asarray(arr)
+
+    def _pad1(self, arr, fill, dtype=np.int32):
         n = len(arr)
         b = _bucket(n)
         out = np.full(b, fill, dtype)
         out[:n] = arr
-        return jnp.asarray(out)
+        return self._dev(out)
 
     def _valid(self, n):
-        import jax.numpy as jnp
         b = _bucket(n)
         v = np.zeros(b, bool)
         v[:n] = True
-        return jnp.asarray(v)
+        return self._dev(v)
 
     def _np(self, out, n):
         """Device outputs -> host numpy, sliced back to live length."""
@@ -488,7 +535,6 @@ class ColumnarBackend(AcceptorBackend):
         """Stack batch columns into ONE padded [k, bucket] i32 array with
         the valid mask as the last row — a single host->device transfer
         per kernel call (link round trips dominate small batches)."""
-        import jax.numpy as jnp
         b = _bucket(n)
         out = np.zeros((len(cols) + 1, b), np.int32)
         for i, (col, fill) in enumerate(cols):
@@ -496,7 +542,7 @@ class ColumnarBackend(AcceptorBackend):
                 out[i, n:] = fill
             out[i, :n] = np.asarray(col).astype(np.int32, copy=False)
         out[len(cols), :n] = 1  # valid mask
-        return jnp.asarray(out)
+        return self._dev(out)
 
     # -- ops ---------------------------------------------------------------
 
@@ -581,7 +627,6 @@ class ColumnarBackend(AcceptorBackend):
 
     def install_coordinator(self, rows, cbals, next_slots, carry_slot,
                             carry_req) -> None:
-        import jax.numpy as jnp
         n = len(rows)
         b = _bucket(n)
         W = self._window
@@ -595,8 +640,8 @@ class ColumnarBackend(AcceptorBackend):
         ch[:n, :m] = hi.reshape(n, m)
         self.state, _ = self._k.install_coordinator(
             self.state, self._pad1(rows, 0), self._pad1(cbals, NO_BALLOT),
-            self._pad1(next_slots, 0), jnp.asarray(cs), jnp.asarray(cl),
-            jnp.asarray(ch), self._valid(n))
+            self._pad1(next_slots, 0), self._dev(cs), self._dev(cl),
+            self._dev(ch), self._valid(n))
 
     def set_cursor(self, rows, cursors, next_slots) -> None:
         n = len(rows)
@@ -626,16 +671,15 @@ class ColumnarBackend(AcceptorBackend):
                 for i in range(len(rows))]
 
     def restore_row(self, row: int, snap: dict) -> None:
-        import jax.numpy as jnp
         from gigapaxos_tpu.ops.types import ColumnarState
         from gigapaxos_tpu.ops.kernels import scatter_rows
         # coerce dtypes: snapshots may round-trip through JSON (pause
         # blobs), which turns u32 vote words / bool flags into int lists
         row_state = ColumnarState(
-            **{f: jnp.asarray(
+            **{f: self._dev(
                 np.asarray(snap[f]).astype(
-                    getattr(self.state, f).dtype))[None]
+                    getattr(self.state, f).dtype)[None])
                for f in ColumnarState._fields})
         self.state, _ = scatter_rows(
-            self.state, jnp.asarray([row], jnp.int32), row_state,
-            jnp.asarray([True]))
+            self.state, self._dev(np.asarray([row], np.int32)), row_state,
+            self._dev(np.asarray([True])))

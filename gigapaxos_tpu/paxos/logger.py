@@ -78,6 +78,10 @@ class PaxosLogger:
         self.compact_threshold = compact_threshold_bytes
         self._wal_path = os.path.join(dirpath, "wal.log")
         self._wal = open(self._wal_path, "ab")
+        # compaction runs on the writer thread (it rewrites the whole
+        # file); the hot path only ever *requests* it when the inline
+        # write crosses the threshold
+        self._compact_pending = False
         # serializes WAL file writes (writer thread) vs compaction's
         # snapshot+replace+handle-swap (caller thread): without it, entries
         # fsync-acked between compact's snapshot and its replace would be
@@ -157,8 +161,15 @@ class PaxosLogger:
             self._wal.flush()
             if self.sync if fsync is None else fsync:
                 os.fsync(self._wal.fileno())
+            over = self._wal.tell() >= self.compact_threshold
         DelayProfiler.update_delay("wal.fsync", t0)
         DelayProfiler.update_rate("wal.entries", n_entries)
+        if over and not self._compact_pending:
+            # hand the rewrite to the writer thread — the worker must
+            # not stall for a whole-file rewrite (ref: SQLPaxosLogger
+            # log GC below the checkpointed slot, done off-path)
+            self._compact_pending = True
+            self._q.put(("__compact__", None))
 
     def _writer_loop(self) -> None:
         while True:
@@ -179,7 +190,11 @@ class PaxosLogger:
             import time
             t0 = time.monotonic()
             bufs = []
+            compact_req = False
             for entries, _ in batch:
+                if entries == "__compact__":
+                    compact_req = True
+                    continue
                 if isinstance(entries, (bytes, bytearray)):
                     bufs.append(entries)  # pre-encoded (log_raw)
                     continue
@@ -195,15 +210,24 @@ class PaxosLogger:
                     if self.sync:
                         os.fsync(self._wal.fileno())
                 for _, fut in batch:
-                    fut.set_result(len(batch))
+                    if fut is not None:
+                        fut.set_result(len(batch))
             except Exception as exc:  # pragma: no cover
                 for _, fut in batch:
-                    fut.set_exception(exc)
+                    if fut is not None:
+                        fut.set_exception(exc)
             DelayProfiler.update_delay("wal.fsync", t0)
             DelayProfiler.update_rate(
                 "wal.entries",
                 sum(1 if isinstance(e, (bytes, bytearray)) else len(e)
-                    for e, _ in batch))
+                    for e, _ in batch if e != "__compact__"))
+            if compact_req:
+                try:
+                    self.compact_if_needed()
+                except Exception:  # pragma: no cover
+                    log.exception("WAL compaction failed")
+                finally:
+                    self._compact_pending = False
 
     def read_wal(self) -> List[LogEntry]:
         """Scan all WAL records (recovery roll-forward)."""
@@ -399,7 +423,7 @@ class PaxosLogger:
             try:
                 while True:
                     item = self._q.get_nowait()
-                    if item is not None:
+                    if item is not None and item[1] is not None:
                         item[1].set_exception(
                             RuntimeError("logger aborted"))
             except queue.Empty:
@@ -411,7 +435,7 @@ class PaxosLogger:
         try:
             while True:
                 item = self._q.get_nowait()
-                if item is not None:
+                if item is not None and item[1] is not None:
                     item[1].set_exception(RuntimeError("logger closed"))
         except queue.Empty:
             pass

@@ -149,7 +149,9 @@ class PaxosNode:
         self._fused = self.backend.store \
             if isinstance(self.backend, NativeBackend) else None
         self.table = GroupTable(cap)
-        self.logger = PaxosLogger(logdir, sync=bool(Config.get(PC.SYNC_WAL)))
+        self.logger = PaxosLogger(
+            logdir, sync=bool(Config.get(PC.SYNC_WAL)),
+            compact_threshold_bytes=int(Config.get(PC.WAL_COMPACT_BYTES)))
         self.batch_size = int(Config.get(PC.BATCH_SIZE))
         self.batch_timeout = float(Config.get(PC.BATCH_TIMEOUT_S))
         self.batch_coalesce = float(Config.get(PC.BATCH_COALESCE_S))
@@ -728,11 +730,24 @@ class PaxosNode:
                 self._resp_out.setdefault(dst, []).append(
                     (obj.gkey, obj.req_id, obj.status, obj.payload))
                 return
+            buf = obj.encode()
+            if len(buf) > pkt.CHUNK_THRESHOLD:
+                # LargeCheckpointer analog: slice oversized frames so
+                # they never hit the single-frame ceiling, and send them
+                # paced by the socket's own flow control (one burst of a
+                # multi-hundred-MB checkpoint would congestion-drop its
+                # own tail against the transport byte budget)
+                self._xfer_seq = getattr(self, "_xfer_seq", 0) + 1
+                xid = (self.id << 32) | self._xfer_seq
+                self.transport.send_paced_threadsafe(
+                    dst, [ch.encode()
+                          for ch in pkt.chunk_frame(self.id, xid, buf)])
+                return
             if self._out_buf is not None:
                 # buffered: one loop hop flushes the whole worker batch
-                self._out_buf.append((dst, obj.encode(), False, 1))
+                self._out_buf.append((dst, buf, False, 1))
             else:
-                self.transport.send_threadsafe(dst, obj.encode())
+                self.transport.send_threadsafe(dst, buf)
         # else: recovery runs before sockets exist; peers re-sync later
 
     def _flush_responses(self) -> None:
@@ -908,6 +923,14 @@ class PaxosNode:
             self._last_bounce_gc = now
             self._bounced = {r: t for r, t in self._bounced.items()
                              if t > now - 30}
+            xfers = getattr(self, "_xfers", None)
+            if xfers:
+                # partial chunked transfers whose chunks were lost: the
+                # sender retries at a higher level (checkpoint catch-up
+                # re-requests), so drop the stale buffers
+                for k in [k for k, v in xfers.items()
+                          if v[0] < now - 60]:
+                    del xfers[k]
         # deactivator pass (ref: PaxosManager's pause thread); batched:
         # one device gather + one pause txn per sweep
         self._sweep_idle(now)
@@ -985,6 +1008,8 @@ class PaxosNode:
             if waiter is not None:
                 self._route(waiter[0], pkt.Response(
                     self.id, o.gkey, o.req_id, o.status, o.payload))
+        for o in by_type.pop(pkt.Chunk, []):
+            self._handle_chunk(o)
         for o in by_type.pop(pkt.SyncRequest, []):
             self._handle_sync_request(o)
         for o in by_type.pop(pkt.SyncReply, []):
@@ -1662,6 +1687,27 @@ class PaxosNode:
             dst = others[0]
         self._route(dst, pkt.SyncRequest(self.id, meta.gkey, cur,
                                          cur + self.backend.window))
+
+    def _handle_chunk(self, o: "pkt.Chunk") -> None:
+        """Reassemble a chunked frame; on completion the inner frame
+        re-enters the worker queue as a normal packet (ref:
+        LargeCheckpointer receive side)."""
+        xfers = getattr(self, "_xfers", None)
+        if xfers is None:
+            xfers = self._xfers = {}
+        key = (o.sender, o.xfer_id)
+        parts = xfers.get(key)
+        if parts is None:
+            parts = xfers[key] = [time.time(), o.nchunks,
+                                  [None] * o.nchunks]
+        if o.seq < parts[1] and parts[2][o.seq] is None:
+            parts[0] = time.time()  # refresh: transfer is alive (a slow
+            # link must not be GC'd mid-flight — only STALLED ones age)
+            parts[2][o.seq] = o.data
+            if all(p is not None for p in parts[2]):
+                del xfers[key]
+                self._inq.put(b"".join(parts[2]))
+        # stale partial transfers (lost chunks) age out in _tick
 
     def _handle_sync_request(self, o) -> None:
         meta = self._lookup(o.gkey)

@@ -65,6 +65,7 @@ class PacketType(IntEnum):
     CHECKPOINT_REQUEST = 15  # ask a peer for its latest app checkpoint
     CHECKPOINT_REPLY = 16
     CONTROL = 17          # JSON control-plane envelope (reconfiguration)
+    CHUNK = 18            # large-frame chunking (LargeCheckpointer analog)
 
 
 _HDR = struct.Struct("<BII")  # type, sender (u32, matches the transport's
@@ -567,6 +568,56 @@ class Control:
         return cls(sender, _json.loads(bytes(body).decode()))
 
 
+@dataclass
+class Chunk:
+    """One slice of an oversized frame (ref: ``paxosutil/
+    LargeCheckpointer`` — the reference streams big checkpoints out of
+    band over a file channel; here any frame above the chunking
+    threshold is sliced into CHUNK frames and reassembled at the
+    receiver, so a multi-hundred-MB checkpoint never has to fit the
+    single-frame ceiling and never stalls the link for other traffic).
+
+    ``xfer_id`` is unique per (sender, transfer); ``seq``/``nchunks``
+    place the slice.  The reassembled payload is a complete wire frame
+    (any type) that re-enters the receiver's demux.
+    """
+
+    sender: int
+    xfer_id: int
+    seq: int
+    nchunks: int
+    data: bytes
+
+    TYPE = PacketType.CHUNK
+    _S = struct.Struct("<QII")
+
+    def encode(self) -> bytes:
+        return (_HDR.pack(self.TYPE, self.sender, 1) +
+                self._S.pack(self.xfer_id, self.seq, self.nchunks) +
+                self.data)
+
+    @classmethod
+    def decode(cls, sender, n, body) -> "Chunk":
+        xfer_id, seq, nchunks = cls._S.unpack_from(body, 0)
+        return cls(sender, xfer_id, seq, nchunks,
+                   bytes(body[cls._S.size:]))
+
+
+# frames above CHUNK_THRESHOLD are sliced into CHUNK_BYTES slices; both
+# are far below the transport's MAX_FRAME so chunked transfers interleave
+# with live traffic instead of head-of-line blocking a connection
+CHUNK_BYTES = 4 * 1024 * 1024
+CHUNK_THRESHOLD = 8 * 1024 * 1024
+
+
+def chunk_frame(sender: int, xfer_id: int, frame: bytes) -> List["Chunk"]:
+    """Slice an encoded frame into Chunk packets."""
+    n = (len(frame) + CHUNK_BYTES - 1) // CHUNK_BYTES
+    return [Chunk(sender, xfer_id, i, n,
+                  frame[i * CHUNK_BYTES:(i + 1) * CHUNK_BYTES])
+            for i in range(n)]
+
+
 # --------------------------------------------------------------------------
 # dispatch
 # --------------------------------------------------------------------------
@@ -589,6 +640,7 @@ _DECODERS = {
     PacketType.CHECKPOINT_REQUEST: CheckpointRequest,
     PacketType.CHECKPOINT_REPLY: CheckpointReply,
     PacketType.CONTROL: Control,
+    PacketType.CHUNK: Chunk,
 }
 
 

@@ -30,13 +30,33 @@ class PC(ConfigKey):
     BATCH_BUSY_ITEMS = 24
     # app checkpoint every this many slots per group (ref ~400)
     CHECKPOINT_INTERVAL = 400
-    # backend: "columnar" (JAX/TPU) or "scalar" (per-instance baseline)
+    # backend: "columnar" (JAX/TPU), "native" (C++ per-instance host
+    # engine), or "scalar" (interpreted per-instance oracle)
     BACKEND = "columnar"
-    # fused Pallas kernel for the acceptor transition (HOT #1); falls
-    # back to the XLA scatter path if Mosaic rejects the shapes
+    # shard the columnar [G, W] state over the group axis of a device
+    # mesh: "auto" = across all local devices when >1 and capacity
+    # divides evenly (SURVEY §2.7 TP row — the runtime path, not just
+    # the storm kernel); "off" = single device
+    COLUMNAR_MESH = "auto"
+    # which jax backend the NODE RUNTIME's columnar engine runs on:
+    # "cpu" (default) pins state + kernels to host XLA — the runtime
+    # makes small per-batch calls where per-call host<->device latency
+    # dominates (measured ~100ms per transfer over this host's TPU
+    # tunnel vs 0.03ms on host XLA; a real co-located TPU would be ~us,
+    # set "default" there).  The storm/bench path addresses the
+    # accelerator directly and is unaffected by this knob.
+    COLUMNAR_DEVICE = "cpu"
+    # fused Pallas kernel for the acceptor transition (HOT #1).  CUT
+    # from the default path: measured >>10x slower than the XLA scatter
+    # path on v5e at every compiling shape (see bench.py pallas probe
+    # and ops/pallas_accept.py STATUS); kept as an opt-in experiment
     USE_PALLAS_ACCEPT = False
     # fsync WAL batches before acking accepts (the durability contract)
     SYNC_WAL = True
+    # compact (GC entries below each group's checkpointed slot) when the
+    # WAL grows past this many bytes; the rewrite runs on the logger's
+    # writer thread, off the worker's hot path
+    WAL_COMPACT_BYTES = 64 * 1024 * 1024
     # failure detection
     PING_INTERVAL_S = 0.5
     FAILURE_TIMEOUT_S = 3.0

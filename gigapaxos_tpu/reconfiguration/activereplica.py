@@ -51,8 +51,12 @@ class ActiveReplica:
         self.coordinator.bind(self.node)
         self.demand_report_every = demand_report_every
         self._demand_acc: Dict[str, int] = {}
-        # stops we have been asked for but whose group is still running
-        self._pending_stops: Dict[str, Tuple[int, int]] = {}  # name->(ep,rc)
+        # stops we have been asked for but whose group is still running:
+        # name -> (epoch, rc, injected_ts); the ts gates re-injection so
+        # reconfigurator retry waves don't flood the data plane with
+        # duplicate stop requests (they dedupe, but each one still costs
+        # a full request-path pass)
+        self._pending_stops: Dict[str, Tuple[int, int, float]] = {}
         self.node.register_handler(pkt.Control, self._on_control)
         self.node.add_tick_hook(self._tick)
 
@@ -79,6 +83,12 @@ class ActiveReplica:
             self._handle_stop_epoch(o.sender, b)
         elif t == rc.DROP_EPOCH:
             self._handle_drop_epoch(o.sender, b)
+        elif t == rc.START_EPOCH_BATCH:
+            self._handle_start_epoch_batch(o.sender, b)
+        elif t == rc.STOP_EPOCH_BATCH:
+            self._handle_stop_epoch_batch(o.sender, b)
+        elif t == rc.DROP_EPOCH_BATCH:
+            self._handle_drop_epoch_batch(o.sender, b)
         elif t == rc.ECHO:
             self.node._route(o.sender, pkt.Control(self.id, b))
         else:
@@ -107,7 +117,12 @@ class ActiveReplica:
             self.node._route(sender, pkt.Control(
                 self.id, rc.ack_stop(name, epoch, "")))
             return
-        self._pending_stops[name] = (epoch, sender)
+        prev = self._pending_stops.get(name)
+        now = time.time()
+        if prev is not None and prev[0] >= epoch and now - prev[2] < 2.0:
+            self._pending_stops[name] = (prev[0], sender, prev[2])
+            return  # stop already in flight; just note the new asker
+        self._pending_stops[name] = (epoch, sender, now)
         # propose the epoch-stop through paxos (dedup via deterministic id)
         self.node._inq.put(pkt.Request(
             self.id, meta.gkey, stop_req_id(name, epoch), FLAG_STOP, b""))
@@ -121,16 +136,77 @@ class ActiveReplica:
         self.node._route(sender, pkt.Control(
             self.id, rc.ack_drop(name, epoch)))
 
+    # -- batched epoch ops (ref: batched CreateServiceName path) -----------
+
+    def _handle_start_epoch_batch(self, sender: int, b: dict) -> None:
+        items = [(nm, epoch, tuple(actives), b64d(init))
+                 for nm, epoch, actives, init in b["items"]]
+        self.coordinator.create_replica_groups(items)
+        acks = []
+        for nm, epoch, _a, _i in items:
+            meta = self.node.table.by_name(nm)
+            if meta is not None and meta.version >= epoch:
+                self._pending_stops.pop(nm, None)
+                acks.append([nm, epoch])
+        if acks:
+            self.node._route(sender, pkt.Control(
+                self.id, rc.ack_start_batch(acks)))
+
+    def _handle_stop_epoch_batch(self, sender: int, b: dict) -> None:
+        acks = []
+        now = time.time()
+        for nm, epoch in b["items"]:
+            done = self.coordinator.stopped_state(nm)
+            if done is not None and done[0] >= epoch:
+                acks.append([nm, done[0], b64e(done[1])])
+                continue
+            meta = self.node.table.by_name(nm)
+            if meta is None or meta.version > epoch:
+                acks.append([nm, epoch, ""])
+                continue
+            prev = self._pending_stops.get(nm)
+            if prev is not None and prev[0] >= epoch and \
+                    now - prev[2] < 2.0:
+                self._pending_stops[nm] = (prev[0], sender, prev[2])
+                continue  # in flight: don't re-inject on retry waves
+            self._pending_stops[nm] = (epoch, sender, now)
+            self.node._inq.put(pkt.Request(
+                self.id, meta.gkey, stop_req_id(nm, epoch), FLAG_STOP,
+                b""))
+        if acks:
+            self.node._route(sender, pkt.Control(
+                self.id, rc.ack_stop_batch(acks)))
+
+    def _handle_drop_epoch_batch(self, sender: int, b: dict) -> None:
+        gone = []
+        for nm, epoch in b["items"]:
+            meta = self.node.table.by_name(nm)
+            if meta is not None and meta.version <= epoch:
+                gone.append(nm)
+            self._pending_stops.pop(nm, None)
+        if gone:
+            self.coordinator.delete_replica_groups(gone)
+
     # -- periodic (worker thread) ------------------------------------------
 
     def _tick(self) -> None:
-        # answer pending stops whose stop request has now executed
-        for name, (epoch, sender) in list(self._pending_stops.items()):
+        # answer pending stops whose stop request has now executed; acks
+        # batch per destination reconfigurator (the churn path)
+        ack_by_dst: Dict[int, list] = {}
+        for name, (epoch, sender, _ts) in list(
+                self._pending_stops.items()):
             done = self.coordinator.stopped_state(name)
             if done is not None and done[0] >= epoch:
                 del self._pending_stops[name]
-                self.node._route(sender, pkt.Control(
-                    self.id, rc.ack_stop(name, done[0], b64e(done[1]))))
+                ack_by_dst.setdefault(sender, []).append(
+                    [name, done[0], b64e(done[1])])
+        for dst, items in ack_by_dst.items():
+            if len(items) == 1:
+                self.node._route(dst, pkt.Control(self.id, rc.ack_stop(
+                    items[0][0], items[0][1], items[0][2])))
+            else:
+                self.node._route(dst, pkt.Control(
+                    self.id, rc.ack_stop_batch(items)))
         # demand reporting (ref: DemandReport via AggregateDemandProfiler)
         for name, cnt in self.coordinator.drain_demand().items():
             self._demand_acc[name] = self._demand_acc.get(name, 0) + cnt

@@ -143,6 +143,39 @@ class ReconfigurableAppClient:
         self._preferred.pop(name, None)
         return bool(b.get("ok"))
 
+    async def create_names(self, names: List[str],
+                           initial_state: bytes = b"",
+                           timeout: Optional[float] = None) -> int:
+        """Batched create (ref: batched CreateServiceName).  One control
+        round trip for the whole batch; the entry reconfigurator buckets
+        by owning RC group and aggregates.  Returns #names now READY."""
+        rid = self._rid()
+        b = rc.create_batch([[n, b64e(initial_state)] for n in names],
+                            rid)
+        resp = await self._control_t(b, timeout)
+        return int(resp.get("n_ok", 0))
+
+    async def delete_names(self, names: List[str],
+                           timeout: Optional[float] = None) -> int:
+        """Batched delete; returns #names now gone."""
+        rid = self._rid()
+        resp = await self._control_t(rc.delete_batch(list(names), rid),
+                                     timeout)
+        for n in names:
+            self._actives_cache.pop(n, None)
+            self._preferred.pop(n, None)
+        return int(resp.get("n_ok", 0))
+
+    async def _control_t(self, body: dict, timeout: Optional[float]):
+        if timeout is None:
+            return await self._control(body)
+        saved = self.timeout
+        self.timeout = timeout
+        try:
+            return await self._control(body)
+        finally:
+            self.timeout = saved
+
     async def get_actives(self, name: str) -> List[int]:
         b = await self._control(rc.req_actives(name, self._rid()))
         if not b.get("ok"):

@@ -96,10 +96,42 @@ class PaxosReplicaCoordinator(AbstractReplicaCoordinator, Replicable):
         return self.node.create_group(name, tuple(members), version=epoch,
                                       initial_state=initial_state)
 
+    def create_replica_groups(self, items) -> int:
+        """Batched create (ref: batched CreateServiceName): ``items`` is
+        ``[(name, epoch, members, initial_state), ...]``; one engine
+        ``create_groups`` call per distinct (epoch, initial_state) class
+        — the 10K-churn path.  Returns how many are (now) present."""
+        ok = 0
+        classes: Dict[Tuple[int, bytes], list] = {}
+        for name, epoch, members, init in items:
+            existing = self.node.table.by_name(name)
+            if existing is not None:
+                if existing.version >= epoch:
+                    ok += 1
+                    continue
+                self.node.delete_group(name)
+            with self._lock:
+                st = self._stopped.get(name)
+                if st is not None and st[0] < epoch:
+                    del self._stopped[name]
+            classes.setdefault((epoch, init), []).append(
+                (name, tuple(members)))
+        for (epoch, init), batch in classes.items():
+            ok += self.node.create_groups(batch, version=epoch,
+                                          initial_state=init)
+        return ok
+
     def delete_replica_group(self, name: str) -> bool:
         with self._lock:
             self._stopped.pop(name, None)
         return self.node.delete_group(name)
+
+    def delete_replica_groups(self, names) -> int:
+        """Batched delete: one engine ``delete_groups`` call."""
+        with self._lock:
+            for n in names:
+                self._stopped.pop(n, None)
+        return self.node.delete_groups(list(names))
 
     def get_replica_group(self, name: str) -> Optional[Tuple[int, ...]]:
         meta = self.node.table.by_name(name)

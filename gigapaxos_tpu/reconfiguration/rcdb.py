@@ -80,6 +80,8 @@ class ReconfiguratorDB(Replicable):
         record on success, None if the op was stale/invalid (idempotence:
         duplicate proposals from multiple reconfigurators are no-ops)."""
         op = cmd["op"]
+        if op.endswith("_batch"):
+            return self._apply_batch(recs, op, cmd)
         n = cmd["name"]
         rec = recs.get(n)
         if op == "create":
@@ -125,6 +127,45 @@ class ReconfiguratorDB(Replicable):
                 return None
             return recs.pop(n)
         return None
+
+    def _apply_batch(self, recs: Dict[str, RCRecord], op: str, cmd: dict
+                     ) -> Optional[List[RCRecord]]:
+        """Batched FSM transitions (ref: batched CreateServiceName):
+        per-name semantics identical to the single ops; returns the list
+        of records that transitioned (None if none did)."""
+        out: List[RCRecord] = []
+        if op == "create_batch":
+            for nm, actives, init in cmd["items"]:
+                if nm in recs:
+                    continue
+                out.append(recs.setdefault(nm, RCRecord(
+                    nm, 0, WAIT_ACK_START, list(actives), list(actives),
+                    init)))
+        elif op == "ready_batch":
+            for nm, epoch in cmd["items"]:
+                r = recs.get(nm)
+                if r is None or r.state != WAIT_ACK_START or \
+                        r.epoch != epoch:
+                    continue
+                r.state = READY
+                r.actives = list(r.new_actives)
+                r.init_b64 = ""
+                out.append(r)
+        elif op == "delete_batch":
+            for nm in cmd["names"]:
+                r = recs.get(nm)
+                if r is None or r.state != READY:
+                    continue
+                r.state = WAIT_ACK_STOP
+                r.deleting = True
+                out.append(r)
+        elif op == "dropped_batch":
+            for nm in cmd["names"]:
+                r = recs.get(nm)
+                if r is None or r.state != WAIT_ACK_STOP or not r.deleting:
+                    continue
+                out.append(recs.pop(nm))
+        return out or None
 
     def checkpoint(self, name: str) -> bytes:
         recs = self.groups.get(name, {})

@@ -41,6 +41,16 @@ DROP_EPOCH = "drop_epoch"
 ACK_DROP = "ack_drop"
 DEMAND = "demand"
 ECHO = "echo"
+# batched name ops (ref: ReconfigurationConfig batched creates — the
+# 10K-churn configs die on one control round trip + two RC-paxos rounds
+# PER NAME; a batch pays them once per few hundred names)
+CREATE_BATCH = "create_batch"
+DELETE_BATCH = "delete_batch"
+START_EPOCH_BATCH = "start_epoch_b"
+ACK_START_BATCH = "ack_start_b"
+STOP_EPOCH_BATCH = "stop_epoch_b"
+ACK_STOP_BATCH = "ack_stop_b"
+DROP_EPOCH_BATCH = "drop_epoch_b"
 
 
 def create_name(name: str, init_b64: str, rid: int) -> dict:
@@ -94,3 +104,43 @@ def ack_drop(name: str, epoch: int) -> dict:
 
 def demand(reports: Dict[str, int]) -> dict:
     return {"rc": DEMAND, "reports": reports}
+
+
+def create_batch(items: List, rid: int) -> dict:
+    """items: [[name, init_b64], ...]"""
+    return {"rc": CREATE_BATCH, "items": [list(i) for i in items],
+            "rid": rid}
+
+
+def delete_batch(names: List[str], rid: int) -> dict:
+    return {"rc": DELETE_BATCH, "names": list(names), "rid": rid}
+
+
+def reply_batch(rid: int, n_ok: int, n_total: int) -> dict:
+    return {"rc": REPLY, "rid": rid, "ok": n_ok == n_total,
+            "n_ok": n_ok, "n_total": n_total}
+
+
+def start_epoch_batch(items: List) -> dict:
+    """items: [[name, epoch, actives, init_b64], ...]"""
+    return {"rc": START_EPOCH_BATCH, "items": [list(i) for i in items]}
+
+
+def ack_start_batch(items: List) -> dict:
+    """items: [[name, epoch], ...]"""
+    return {"rc": ACK_START_BATCH, "items": [list(i) for i in items]}
+
+
+def stop_epoch_batch(items: List) -> dict:
+    """items: [[name, epoch], ...]"""
+    return {"rc": STOP_EPOCH_BATCH, "items": [list(i) for i in items]}
+
+
+def ack_stop_batch(items: List) -> dict:
+    """items: [[name, epoch, final_b64], ...]"""
+    return {"rc": ACK_STOP_BATCH, "items": [list(i) for i in items]}
+
+
+def drop_epoch_batch(items: List) -> dict:
+    """items: [[name, epoch], ...]"""
+    return {"rc": DROP_EPOCH_BATCH, "items": [list(i) for i in items]}

@@ -69,6 +69,16 @@ class Reconfigurator:
         # name -> [(rid, client, kind)] awaiting a terminal transition
         self._pending: Dict[str, List[Tuple[int, int, str]]] = {}
         self._relay: Dict[int, int] = {}          # rid -> original client
+        # batched name ops: rid -> {"client", "left": set(names), "ts",
+        # "n_total", "n_done"}; (name, kind) -> rid reverse index (kind
+        # keyed: a delete batch waiting on a name mid-create must not be
+        # credited by the create's READY transition)
+        self._batches: Dict[int, dict] = {}
+        self._batch_of: Dict[Tuple[str, str], int] = {}
+        # batch-relay aggregation: parent rid -> {"client", "subs": set,
+        # "n_ok", "n_total", "ts"}
+        self._agg: Dict[int, dict] = {}
+        self._sub_parent: Dict[int, int] = {}
         self._acks_start: Dict[Tuple[str, int], Set[int]] = {}
         self._final: Dict[Tuple[str, int], str] = {}   # epoch final states
         self._demand: Dict[str, int] = {}
@@ -134,13 +144,21 @@ class Reconfigurator:
         if t in (rc.CREATE_NAME, rc.DELETE_NAME, rc.REQ_ACTIVES,
                  rc.MOVE_NAME):
             self._client_op(o.sender, t, b)
+        elif t in (rc.CREATE_BATCH, rc.DELETE_BATCH):
+            self._client_batch(o.sender, t, b)
+        elif t == rc.REPLY and b.get("rid") in self._sub_parent:
+            self._on_sub_reply(b)
         elif t == rc.REPLY and b.get("rid") in self._relay:
             self.node._route(self._relay.pop(b["rid"])[0],
                              pkt.Control(self.id, b))
         elif t == rc.ACK_START:
             self._on_ack_start(o.sender, b)
+        elif t == rc.ACK_START_BATCH:
+            self._on_ack_start_batch(o.sender, b)
         elif t == rc.ACK_STOP:
             self._on_ack_stop(o.sender, b)
+        elif t == rc.ACK_STOP_BATCH:
+            self._on_ack_stop_batch(o.sender, b)
         elif t == rc.ACK_DROP:
             pass
         elif t == rc.DEMAND:
@@ -214,6 +232,172 @@ class Reconfigurator:
                 (rid, sender, "move", b, time.time()))
             self._propose(grp, {"op": "move", "name": name,
                                 "new_actives": list(b["new_actives"])})
+
+    # -- batched name ops (ref: batched CreateServiceName) -----------------
+
+    def _client_batch(self, sender: int, t: str, b: dict) -> None:
+        """CREATE_BATCH / DELETE_BATCH: bucket names by owning RC group,
+        run owned buckets through one proposed batch op each, relay
+        foreign buckets to their owners as sub-batches and aggregate the
+        replies for the client."""
+        rid = b["rid"]
+        now = time.time()
+        if t == rc.CREATE_BATCH:
+            by_grp: Dict[str, list] = {}
+            for nm, init in b["items"]:
+                by_grp.setdefault(self.group_of(nm), []).append((nm, init))
+        else:
+            by_grp = {}
+            for nm in b["names"]:
+                by_grp.setdefault(self.group_of(nm), []).append(nm)
+        agg = {"client": sender, "subs": set(), "n_ok": 0,
+               "n_total": sum(len(v) for v in by_grp.values()),
+               "ts": now}
+        self._agg[rid] = agg
+        for grp, items in by_grp.items():
+            sub_rid = (self.id << 32) | next(self._seq)
+            agg["subs"].add(sub_rid)
+            self._sub_parent[sub_rid] = rid
+            if self.id in self.group_members(grp):
+                self._local_batch(grp, t, items, sub_rid, self.id)
+            else:
+                body = rc.create_batch(items, sub_rid) \
+                    if t == rc.CREATE_BATCH \
+                    else rc.delete_batch(items, sub_rid)
+                self.node._route(self._live_member(grp),
+                                 pkt.Control(self.id, body))
+
+    def _local_batch(self, grp: str, t: str, items: list, rid: int,
+                     client: int) -> None:
+        """One owned bucket: register completion tracking and propose the
+        batch FSM op.  Names already in the target state count done."""
+        now = time.time()
+        if t == rc.CREATE_BATCH:
+            todo, done = [], 0
+            left = set()
+            for nm, init in items:
+                rec = self.db.lookup(grp, nm)
+                if rec is not None and rec.state == READY:
+                    done += 1
+                    continue
+                left.add(nm)
+                self._batch_of[(nm, "create")] = rid
+                if rec is None:
+                    todo.append([nm, self.ch_active.replicated_servers(
+                        nm, self.k_active), init])
+            self._batches[rid] = {"client": client, "left": left,
+                                  "ts": now, "n_total": len(items),
+                                  "n_done": done, "kind": "create",
+                                  "grp": grp}
+            if todo:
+                self._propose(grp, {"op": "create_batch", "items": todo})
+            self._maybe_finish_batch(rid)
+        else:
+            todo2, done = [], 0
+            left = set()
+            for nm in items:
+                rec = self.db.lookup(grp, nm)
+                if rec is None:
+                    done += 1  # already gone: delete is idempotent-ok
+                    continue
+                left.add(nm)
+                self._batch_of[(nm, "delete")] = rid
+                if rec.state == READY:
+                    todo2.append(nm)
+            self._batches[rid] = {"client": client, "left": left,
+                                  "ts": now, "n_total": len(items),
+                                  "n_done": done, "kind": "delete",
+                                  "grp": grp}
+            if todo2:
+                self._propose(grp, {"op": "delete_batch", "names": todo2})
+            self._maybe_finish_batch(rid)
+
+    def _batch_name_done(self, name: str, kind: str) -> None:
+        rid = self._batch_of.pop((name, kind), None)
+        if kind == "create":
+            # a delete batch pended while this name was mid-create can
+            # proceed now that the record is READY
+            del_rid = self._batch_of.get((name, "delete"))
+            if del_rid is not None:
+                self._propose(self.group_of(name),
+                              {"op": "delete", "name": name})
+        if rid is None:
+            return
+        batch = self._batches.get(rid)
+        if batch is None:
+            return
+        if name in batch["left"]:
+            batch["left"].discard(name)
+            batch["n_done"] += 1
+            self._maybe_finish_batch(rid)
+
+    def _maybe_finish_batch(self, rid: int) -> None:
+        batch = self._batches.get(rid)
+        if batch is None or batch["left"]:
+            return
+        del self._batches[rid]
+        self.node._route(batch["client"], pkt.Control(
+            self.id, rc.reply_batch(rid, batch["n_done"],
+                                    batch["n_total"])))
+
+    def _on_sub_reply(self, b: dict) -> None:
+        """A relayed sub-batch completed at its owner: fold into the
+        parent aggregate; reply to the client when all buckets land."""
+        sub = b["rid"]
+        parent = self._sub_parent.pop(sub, None)
+        if parent is None:
+            return
+        agg = self._agg.get(parent)
+        if agg is None:
+            return
+        agg["subs"].discard(sub)
+        agg["n_ok"] += int(b.get("n_ok", 0))
+        if not agg["subs"]:
+            del self._agg[parent]
+            self.node._route(agg["client"], pkt.Control(
+                self.id, rc.reply_batch(parent, agg["n_ok"],
+                                        agg["n_total"])))
+
+    def _on_ack_start_batch(self, sender: int, b: dict) -> None:
+        ready_by_grp: Dict[str, list] = {}
+        for name, epoch in b["items"]:
+            rec = self.db.lookup(self.group_of(name), name)
+            if rec is None or rec.state != WAIT_ACK_START or \
+                    rec.epoch != epoch:
+                continue
+            acks = self._acks_start.setdefault((name, epoch), set())
+            acks.add(sender)
+            if len(acks & set(rec.new_actives)) >= \
+                    len(rec.new_actives) // 2 + 1:
+                ready_by_grp.setdefault(self.group_of(name), []).append(
+                    [name, epoch])
+        # names that crossed majority in THIS ack wave commit READY
+        # together — one RC-paxos round per OWNING group (retry waves
+        # mix names from every group this node serves)
+        for grp, items in ready_by_grp.items():
+            self._propose(grp, {"op": "ready_batch", "items": items})
+
+    def _on_ack_stop_batch(self, sender: int, b: dict) -> None:
+        dropped = []
+        for name, epoch, final in b["items"]:
+            rec = self.db.lookup(self.group_of(name), name)
+            if rec is None or rec.state != WAIT_ACK_STOP or \
+                    epoch < rec.epoch:
+                continue
+            if rec.deleting:
+                dropped.append(name)
+            else:
+                # batched acks only drive deletes; moves stay on the
+                # single-op path (they carry final state per name)
+                if final:
+                    self._final[(name, rec.epoch)] = final
+                    self._propose(self.group_of(name), {
+                        "op": "start_next", "name": name, "init": final})
+        by_grp: Dict[str, list] = {}
+        for nm in dropped:
+            by_grp.setdefault(self.group_of(nm), []).append(nm)
+        for grp, names in by_grp.items():
+            self._propose(grp, {"op": "dropped_batch", "names": names})
 
     # -- acks from actives -------------------------------------------------
 
@@ -292,6 +476,9 @@ class Reconfigurator:
         if rec is None:
             return  # stale/duplicate op: first application already acted
         op = cmd["op"]
+        if op.endswith("_batch"):
+            self._on_commit_batch(op, rec)  # rec is a list here
+            return
         name = rec.name
         if op in ("create", "start_next"):
             self._send_start_epoch(rec)
@@ -305,6 +492,7 @@ class Reconfigurator:
                     self.id, rc.drop_epoch(name, rec.epoch - 1)))
             rec.prev_actives = []
             self._flush_pending(name, ("create", "move"), True, rec.actives)
+            self._batch_name_done(name, "create")
         elif op in ("delete", "move"):
             self._send_stop_epoch(rec)
         elif op == "dropped":
@@ -313,6 +501,52 @@ class Reconfigurator:
                     self.id, rc.drop_epoch(name, rec.epoch)))
             self._final.pop((name, rec.epoch), None)
             self._flush_pending(name, ("delete",), True, [])
+            self._batch_name_done(name, "delete")
+
+    def _on_commit_batch(self, op: str, recs: List[RCRecord]) -> None:
+        """Side effects of a committed batch FSM op (every RC group
+        member runs this idempotently, like the single-op path)."""
+        if op == "create_batch":
+            # one start_epoch_batch per active carrying all its names
+            per_active: Dict[int, list] = {}
+            for r in recs:
+                for a in r.new_actives:
+                    per_active.setdefault(a, []).append(
+                        [r.name, r.epoch, r.new_actives, r.init_b64])
+            for a, items in per_active.items():
+                self.node._route(a, pkt.Control(
+                    self.id, rc.start_epoch_batch(items)))
+        elif op == "ready_batch":
+            for r in recs:
+                self._acks_start.pop((r.name, r.epoch), None)
+                self._final.pop((r.name, r.epoch - 1), None)
+                for a in r.prev_actives:
+                    self.node._route(a, pkt.Control(
+                        self.id, rc.drop_epoch(r.name, r.epoch - 1)))
+                r.prev_actives = []
+                self._flush_pending(r.name, ("create", "move"), True,
+                                    r.actives)
+                self._batch_name_done(r.name, "create")
+        elif op == "delete_batch":
+            per_active = {}
+            for r in recs:
+                for a in r.actives:
+                    per_active.setdefault(a, []).append([r.name, r.epoch])
+            for a, items in per_active.items():
+                self.node._route(a, pkt.Control(
+                    self.id, rc.stop_epoch_batch(items)))
+        elif op == "dropped_batch":
+            per_active = {}
+            for r in recs:
+                for a in r.actives:
+                    per_active.setdefault(a, []).append([r.name, r.epoch])
+                self._final.pop((r.name, r.epoch), None)
+            for a, items in per_active.items():
+                self.node._route(a, pkt.Control(
+                    self.id, rc.drop_epoch_batch(items)))
+            for r in recs:
+                self._flush_pending(r.name, ("delete",), True, [])
+                self._batch_name_done(r.name, "delete")
 
     _KIND_TYPE = {"create": rc.CREATE_NAME, "delete": rc.DELETE_NAME,
                   "move": rc.MOVE_NAME}
@@ -359,9 +593,52 @@ class Reconfigurator:
         self._pending = {
             n: kept for n, es in self._pending.items()
             if (kept := [e for e in es if e[4] > cutoff])}
+        for rid in [r for r, v in self._batches.items()
+                    if v["ts"] < cutoff]:
+            batch = self._batches.pop(rid)
+            for nm in batch["left"]:
+                if self._batch_of.get((nm, batch["kind"])) == rid:
+                    del self._batch_of[(nm, batch["kind"])]
+        for rid in [r for r, v in self._agg.items() if v["ts"] < cutoff]:
+            agg = self._agg.pop(rid)
+            for sub in agg["subs"]:
+                self._sub_parent.pop(sub, None)
+        # BATCHED re-drives: with hundreds of in-flight records (churn
+        # batches), per-record singles here would storm the actives with
+        # single-op epochs and flood the RC groups' windows with
+        # single-name FSM proposals — the very stampede batching exists
+        # to avoid
+        # age gating: a record is only re-driven after sitting in its
+        # WAIT_* state for a full retry period — without this, every
+        # in-flight batch gets re-sent every second while it is making
+        # normal progress, and the duplicate epochs/stops saturate the
+        # actives (measured: 10x churn slowdown)
+        start_by_active: Dict[int, list] = {}
+        stop_by_active: Dict[int, list] = {}
+        state_ts = getattr(self, "_state_ts", {})
+        new_ts: Dict[tuple, float] = {}
         for grp in self.my_groups():
             for rec in list(self.db.groups.get(grp, {}).values()):
+                if rec.state == READY:
+                    continue
+                key = (rec.name, rec.state, rec.epoch)
+                first = state_ts.get(key, now)
+                new_ts[key] = first
+                if now - first < self.retry_s:
+                    continue  # young: in-flight machinery still working
                 if rec.state == WAIT_ACK_START:
-                    self._send_start_epoch(rec)
+                    for a in rec.new_actives:
+                        start_by_active.setdefault(a, []).append(
+                            [rec.name, rec.epoch, rec.new_actives,
+                             rec.init_b64])
                 elif rec.state == WAIT_ACK_STOP:
-                    self._send_stop_epoch(rec)
+                    for a in rec.actives:
+                        stop_by_active.setdefault(a, []).append(
+                            [rec.name, rec.epoch])
+        self._state_ts = new_ts  # entries for departed states fall away
+        for a, items in start_by_active.items():
+            self.node._route(a, pkt.Control(
+                self.id, rc.start_epoch_batch(items)))
+        for a, items in stop_by_active.items():
+            self.node._route(a, pkt.Control(
+                self.id, rc.stop_epoch_batch(items)))

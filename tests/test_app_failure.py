@@ -15,6 +15,8 @@ import socket
 import struct
 import time
 
+import pytest
+
 from gigapaxos_tpu.paxos import packets as pkt
 from gigapaxos_tpu.paxos.client import PaxosClient
 from gigapaxos_tpu.paxos.interfaces import CounterApp
@@ -49,8 +51,9 @@ def _converged(nodes, name, count, deadline_s=10):
     return False
 
 
-def test_transient_failure_retried_in_place(tmp_path):
-    nodes, addr_map = make_cluster(tmp_path, backend="scalar",
+@pytest.mark.parametrize("backend", ["scalar", "native", "columnar"])
+def test_transient_failure_retried_in_place(tmp_path, backend):
+    nodes, addr_map = make_cluster(tmp_path, backend=backend,
                                    app_cls=FlakyApp)
     try:
         for nd in nodes:
@@ -72,8 +75,9 @@ def test_transient_failure_retried_in_place(tmp_path):
         shutdown(nodes)
 
 
-def test_deterministic_failure_advances_and_caches(tmp_path):
-    nodes, addr_map = make_cluster(tmp_path, backend="scalar",
+@pytest.mark.parametrize("backend", ["scalar", "native", "columnar"])
+def test_deterministic_failure_advances_and_caches(tmp_path, backend):
+    nodes, addr_map = make_cluster(tmp_path, backend=backend,
                                    app_cls=FlakyApp)
     try:
         for nd in nodes:
@@ -97,10 +101,11 @@ def test_deterministic_failure_advances_and_caches(tmp_path):
         shutdown(nodes)
 
 
-def test_failed_request_retransmit_answered_from_cache(tmp_path):
+@pytest.mark.parametrize("backend", ["scalar", "native", "columnar"])
+def test_failed_request_retransmit_answered_from_cache(tmp_path, backend):
     """Raw-socket retransmit with the SAME req_id: the second send must be
     answered status 4 from the response cache without re-execution."""
-    nodes, addr_map = make_cluster(tmp_path, backend="scalar",
+    nodes, addr_map = make_cluster(tmp_path, backend=backend,
                                    app_cls=FlakyApp)
     try:
         for nd in nodes:

@@ -45,7 +45,7 @@ def shutdown(nodes):
         nd.stop()
 
 
-@pytest.mark.parametrize("backend", ["scalar", "columnar"])
+@pytest.mark.parametrize("backend", ["scalar", "native", "columnar"])
 def test_single_group_requests(tmp_path, backend):
     nodes, addr_map = make_cluster(tmp_path, backend=backend)
     try:

@@ -66,7 +66,8 @@ def test_coordinator_failover(tmp_path):
         shutdown([nd for nd in nodes if not nd._stopping])
 
 
-def test_failover_under_message_loss(tmp_path):
+@pytest.mark.parametrize("backend", ["scalar", "native", "columnar"])
+def test_failover_under_message_loss(tmp_path, backend):
     """Coordinator crash with 20% loss on EVERY link: the periodic
     run-for-coordinator re-check + election re-drive must converge — a
     single lost Prepare/PrepareReply used to wedge the group forever
@@ -74,7 +75,7 @@ def test_failover_under_message_loss(tmp_path):
     checkRunForCoordinator, SURVEY §3.5)."""
     Config.set(PC.PING_INTERVAL_S, 0.15)
     Config.set(PC.FAILURE_TIMEOUT_S, 1.0)
-    nodes, addr_map = make_cluster(tmp_path, backend="scalar")
+    nodes, addr_map = make_cluster(tmp_path, backend=backend)
     cli = None
     try:
         name = "lossy-fo"

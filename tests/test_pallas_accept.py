@@ -127,7 +127,10 @@ def test_columnar_backend_pallas_path():
     """ColumnarBackend with the Pallas accept enabled (interpret on CPU)
     agrees with the default XLA path through the backend SPI."""
     from gigapaxos_tpu.paxos.backend import ColumnarBackend
+    from gigapaxos_tpu.paxos.paxosconfig import PC
+    from gigapaxos_tpu.utils.config import Config
 
+    Config.set(PC.COLUMNAR_MESH, "off")  # Mosaic path is single-device
     G, W, B = 64, 8, 24
     rng = np.random.default_rng(7)
     bks = [ColumnarBackend(G, W, use_pallas_accept=flag)
