@@ -113,12 +113,11 @@ def bench_columnar(G: int, W: int, B: int, iters: int, warmup: int,
     }
 
 
-def _baseline_pipeline(backend_cls, G, W, B, iters, label):
+def _baseline_pipeline(make_backend, G, W, B, iters):
     """Full propose→accept×3→reply×3→commit×3 through an
     AcceptorBackend triple (one store per emulated replica)."""
     rng = np.random.default_rng(1)
-    backends = [backend_cls(G, W) if backend_cls.__name__ ==
-                "NativeBackend" else backend_cls(W) for _ in range(3)]
+    backends = [make_backend() for _ in range(3)]
     rows = np.arange(G, dtype=np.int32)
     for r, b in enumerate(backends):
         b.create(rows, np.full(G, 3, np.int32), np.zeros(G, np.int32),
@@ -150,14 +149,14 @@ def _baseline_pipeline(backend_cls, G, W, B, iters, label):
 def bench_native_baseline(G: int, W: int, B: int, iters: int) -> float:
     """C++ per-instance engine: the Java-equivalent-hot-path baseline."""
     from gigapaxos_tpu.paxos.backend import NativeBackend
-    return _baseline_pipeline(NativeBackend, G, W, B, iters, "native")
+    return _baseline_pipeline(lambda: NativeBackend(G, W), G, W, B, iters)
 
 
 def bench_python_baseline(G: int, W: int, B: int, iters: int) -> float:
     """Interpreted per-instance Python (the property-test oracle) —
     context only, NOT the headline baseline."""
     from gigapaxos_tpu.paxos.backend import ScalarBackend
-    return _baseline_pipeline(ScalarBackend, G, W, B, iters, "scalar")
+    return _baseline_pipeline(lambda: ScalarBackend(W), G, W, B, iters)
 
 
 def bench_pallas_accept(G: int, W: int, B: int, iters: int):
@@ -223,7 +222,7 @@ def bench_pallas_accept(G: int, W: int, B: int, iters: int):
     return pal, xla
 
 
-def main():
+def _parser():
     p = argparse.ArgumentParser()
     p.add_argument("--groups", type=int, default=1 << 20)
     p.add_argument("--window", type=int, default=16)
@@ -236,13 +235,66 @@ def main():
     p.add_argument("--baseline-iters", type=int, default=30)
     p.add_argument("--quick", action="store_true",
                    help="small shapes (CI / smoke)")
-    args = p.parse_args()
+    p.add_argument("--child", action="store_true", help=argparse.SUPPRESS)
+    p.add_argument("--force-cpu", action="store_true",
+                   help="pin jax to host XLA (accelerator bypass)")
+    return p
+
+
+def main():
+    args = _parser().parse_args()
     if args.quick:
         args.groups, args.batch, args.iters = 1 << 14, 1 << 12, 5
         args.baseline_groups, args.baseline_batch = 1 << 12, 1 << 11
         args.baseline_iters = 4
         args.trials = 3
+    if args.child or args.force_cpu:
+        if args.force_cpu:
+            import jax
+            jax.config.update("jax_platforms", "cpu")
+        print(json.dumps(run_bench(args)))
+        return 0
+    # Watchdog wrapper: the measurement runs in a child process so a
+    # hung accelerator plugin (observed: the remote TPU tunnel wedging
+    # hard enough that even backend init blocks forever) cannot hang the
+    # whole bench.  On timeout/failure, re-run pinned to host XLA with
+    # the platform labeled — a wrong-looking-but-present number beats a
+    # silent hang in the round artifacts.
+    import subprocess
+    budget = int(os.environ.get("GP_BENCH_TIMEOUT_S",
+                                "240" if args.quick else "540"))
+    argv = [sys.executable, os.path.abspath(__file__), "--child"] + \
+        sys.argv[1:]
+    reason = None
+    try:
+        res = subprocess.run(argv, capture_output=True, timeout=budget)
+        line = res.stdout.decode().strip().splitlines()[-1] \
+            if res.stdout.strip() else ""
+        if res.returncode == 0 and line.startswith("{"):
+            print(line)
+            return 0
+        reason = f"primary run failed rc={res.returncode}"
+        sys.stderr.write(res.stderr.decode()[-2000:])
+    except subprocess.TimeoutExpired:
+        reason = f"accelerator hung (> {budget}s)"
+    try:
+        res = subprocess.run(
+            argv + ["--force-cpu"], capture_output=True, timeout=budget)
+    except subprocess.TimeoutExpired:
+        sys.stderr.write(f"bench: fallback also exceeded {budget}s\n")
+        return 1
+    line = res.stdout.decode().strip().splitlines()[-1] \
+        if res.stdout.strip() else ""
+    if res.returncode == 0 and line.startswith("{"):
+        out = json.loads(line)
+        out["metric"] += f" [FALLBACK on host XLA: {reason}]"
+        print(json.dumps(out))
+        return 0
+    sys.stderr.write(res.stderr.decode()[-2000:])
+    return 1
 
+
+def run_bench(args) -> dict:
     cps, info = bench_columnar(args.groups, args.window, args.batch,
                                args.iters, args.warmup, args.trials)
     nps = bench_native_baseline(args.baseline_groups, args.window,
@@ -269,7 +321,7 @@ def main():
                 pallas_accept_per_s=round(pal_rate) if pal_rate else None,
                 xla_accept_per_s=round(xla_rate) if xla_rate else None,
                 groups=args.groups, batch=args.batch)
-    print(json.dumps({
+    return {
         "metric": f"paxos decisions/sec @ {args.groups} groups "
                   "(batched accept storms, 3 replicas; baseline = C++ "
                   "per-instance engine on host)",
@@ -280,8 +332,7 @@ def main():
         "trials": args.trials,
         "spread": info["spread"],
         "info": info,
-    }))
-    return 0
+    }
 
 
 if __name__ == "__main__":

@@ -69,3 +69,11 @@ class PC(ConfigKey):
     PAUSE_MAX_PER_TICK = 256
     # max requests outstanding per client connection before pushback
     CLIENT_MAX_OUTSTANDING = 8192
+    # intake rate limit (ref: paxosutil/RateLimiter): client REQUESTs
+    # beyond this many per second are answered status 1 ("retry") at the
+    # door instead of admitted to the pipeline; 0 disables
+    MAX_INTAKE_RPS = 0
+    # per-request cross-stage tracing (ref: paxosutil/
+    # RequestInstrumenter at FINE level): records recv/prop/acc/dec/exec
+    # events into utils.instrument.RequestInstrumenter's global ring
+    TRACE_REQUESTS = False

@@ -70,11 +70,12 @@ class Reconfigurator:
         self._pending: Dict[str, List[Tuple[int, int, str]]] = {}
         self._relay: Dict[int, int] = {}          # rid -> original client
         # batched name ops: rid -> {"client", "left": set(names), "ts",
-        # "n_total", "n_done"}; (name, kind) -> rid reverse index (kind
-        # keyed: a delete batch waiting on a name mid-create must not be
-        # credited by the create's READY transition)
+        # "n_total", "n_done"}; (name, kind) -> [rids] reverse index
+        # (kind keyed: a delete batch waiting on a name mid-create must
+        # not be credited by the create's READY transition; a LIST
+        # because concurrent clients can batch the same name)
         self._batches: Dict[int, dict] = {}
-        self._batch_of: Dict[Tuple[str, str], int] = {}
+        self._batch_of: Dict[Tuple[str, str], List[int]] = {}
         # batch-relay aggregation: parent rid -> {"client", "subs": set,
         # "n_ok", "n_total", "ts"}
         self._agg: Dict[int, dict] = {}
@@ -250,6 +251,10 @@ class Reconfigurator:
             by_grp = {}
             for nm in b["names"]:
                 by_grp.setdefault(self.group_of(nm), []).append(nm)
+        if not by_grp:  # empty batch: trivially complete
+            self.node._route(sender, pkt.Control(
+                self.id, rc.reply_batch(rid, 0, 0)))
+            return
         agg = {"client": sender, "subs": set(), "n_ok": 0,
                "n_total": sum(len(v) for v in by_grp.values()),
                "ts": now}
@@ -281,7 +286,7 @@ class Reconfigurator:
                     done += 1
                     continue
                 left.add(nm)
-                self._batch_of[(nm, "create")] = rid
+                self._batch_of.setdefault((nm, "create"), []).append(rid)
                 if rec is None:
                     todo.append([nm, self.ch_active.replicated_servers(
                         nm, self.k_active), init])
@@ -301,7 +306,7 @@ class Reconfigurator:
                     done += 1  # already gone: delete is idempotent-ok
                     continue
                 left.add(nm)
-                self._batch_of[(nm, "delete")] = rid
+                self._batch_of.setdefault((nm, "delete"), []).append(rid)
                 if rec.state == READY:
                     todo2.append(nm)
             self._batches[rid] = {"client": client, "left": left,
@@ -313,23 +318,21 @@ class Reconfigurator:
             self._maybe_finish_batch(rid)
 
     def _batch_name_done(self, name: str, kind: str) -> None:
-        rid = self._batch_of.pop((name, kind), None)
+        rids = self._batch_of.pop((name, kind), None)
         if kind == "create":
             # a delete batch pended while this name was mid-create can
             # proceed now that the record is READY
-            del_rid = self._batch_of.get((name, "delete"))
-            if del_rid is not None:
+            if self._batch_of.get((name, "delete")):
                 self._propose(self.group_of(name),
                               {"op": "delete", "name": name})
-        if rid is None:
-            return
-        batch = self._batches.get(rid)
-        if batch is None:
-            return
-        if name in batch["left"]:
-            batch["left"].discard(name)
-            batch["n_done"] += 1
-            self._maybe_finish_batch(rid)
+        for rid in rids or ():
+            batch = self._batches.get(rid)
+            if batch is None:
+                continue
+            if name in batch["left"]:
+                batch["left"].discard(name)
+                batch["n_done"] += 1
+                self._maybe_finish_batch(rid)
 
     def _maybe_finish_batch(self, rid: int) -> None:
         batch = self._batches.get(rid)
@@ -597,8 +600,11 @@ class Reconfigurator:
                     if v["ts"] < cutoff]:
             batch = self._batches.pop(rid)
             for nm in batch["left"]:
-                if self._batch_of.get((nm, batch["kind"])) == rid:
-                    del self._batch_of[(nm, batch["kind"])]
+                rids = self._batch_of.get((nm, batch["kind"]))
+                if rids and rid in rids:
+                    rids.remove(rid)
+                    if not rids:
+                        del self._batch_of[(nm, batch["kind"])]
         for rid in [r for r, v in self._agg.items() if v["ts"] < cutoff]:
             agg = self._agg.pop(rid)
             for sub in agg["subs"]:

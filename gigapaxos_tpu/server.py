@@ -89,6 +89,12 @@ def main(argv=None) -> int:
                    help="WAL/checkpoint directory")
     p.add_argument("--app", default=None,
                    help="override APPLICATION from the properties file")
+    p.add_argument("--paxos-only", action="store_true",
+                   help="boot a bare PaxosNode with no reconfigurators "
+                        "(ref: gigapaxos/PaxosServer deployments): only "
+                        "active.* entries are used; groups are created "
+                        "by clients (CreateGroup) or the GROUPS= "
+                        "properties key (members = all actives)")
     args = p.parse_args(argv)
 
     extras = read_extras(args.config)
@@ -110,12 +116,31 @@ def main(argv=None) -> int:
     app_spec = args.app or extras.get("APPLICATION", "KVApp")
     app_factory = load_app(app_spec)
 
-    node = ReconfigurableNode(args.id, config, app_factory, args.logdir,
-                              **node_kw)
-    roles = [r for r, x in (("active", node.active),
-                            ("reconfigurator", node.reconfigurator)) if x]
-    log.info("node %d starting roles=%s app=%s", args.id, roles, app_spec)
-    node.start()
+    if args.paxos_only:
+        # PaxosServer-style deployment: the engine without the control
+        # plane (ref: gigapaxos/PaxosServer.java main)
+        import os as _os
+
+        from gigapaxos_tpu.paxos.manager import PaxosNode
+
+        addr_map = dict(config.actives)
+        node = PaxosNode(args.id, addr_map, app_factory(),
+                         _os.path.join(args.logdir, f"px{args.id}"),
+                         **node_kw)
+        log.info("node %d starting paxos-only app=%s", args.id, app_spec)
+        node.start()
+        members = tuple(sorted(addr_map))
+        for g in [g for g in extras.get("GROUPS", "").split(",") if g]:
+            node.create_group(g.strip(), members)
+    else:
+        node = ReconfigurableNode(args.id, config, app_factory,
+                                  args.logdir, **node_kw)
+        roles = [r for r, x in (("active", node.active),
+                                ("reconfigurator",
+                                 node.reconfigurator)) if x]
+        log.info("node %d starting roles=%s app=%s", args.id, roles,
+                 app_spec)
+        node.start()
 
     stop = threading.Event()
     for sig in (signal.SIGINT, signal.SIGTERM):

@@ -1,0 +1,67 @@
+"""Per-request cross-stage tracing.
+
+Reference analog: ``gigapaxos/paxosutil/RequestInstrumenter.java`` — at
+FINE log level the reference records per-request send/receive timestamps
+across nodes so a single request's path can be reconstructed.  Here:
+a process-global ring of (req_id, stage, node, t) events, enabled by
+``PC.TRACE_REQUESTS`` (or ``RequestInstrumenter.enabled = True``), with
+near-zero cost when disabled (one class-attribute check at each hook).
+
+Stages recorded by the node runtime: ``recv`` (entry intake), ``prop``
+(slot granted at the coordinator), ``acc`` (accept fsync-durable),
+``dec`` (quorum crossed), ``exec`` (app executed / response queued).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Tuple
+
+
+class RequestInstrumenter:
+    """Global trace ring; thread-safe, bounded."""
+
+    enabled: bool = False
+    _lock = threading.Lock()
+    _ring: "deque" = deque(maxlen=200_000)
+
+    @classmethod
+    def record(cls, req_id: int, stage: str, node: int) -> None:
+        if not cls.enabled:
+            return
+        with cls._lock:
+            cls._ring.append((req_id, stage, node, time.monotonic()))
+
+    @classmethod
+    def trace(cls, req_id: int) -> List[Tuple[str, int, float]]:
+        """(stage, node, t) events of one request, time-ordered."""
+        with cls._lock:
+            evs = [(s, n, t) for r, s, n, t in cls._ring if r == req_id]
+        return sorted(evs, key=lambda e: e[2])
+
+    @classmethod
+    def spans(cls, req_id: int) -> Dict[str, float]:
+        """Stage-to-stage latencies (seconds) for one request."""
+        evs = cls.trace(req_id)
+        out: Dict[str, float] = {}
+        for (s1, _n1, t1), (s2, _n2, t2) in zip(evs, evs[1:]):
+            out[f"{s1}->{s2}"] = t2 - t1
+        if evs:
+            out["total"] = evs[-1][2] - evs[0][2]
+        return out
+
+    @classmethod
+    def format(cls, req_id: int) -> str:
+        evs = cls.trace(req_id)
+        if not evs:
+            return f"req {req_id:#x}: no trace"
+        t0 = evs[0][2]
+        return f"req {req_id:#x}: " + " ".join(
+            f"{s}@n{n}+{(t - t0) * 1e3:.2f}ms" for s, n, t in evs)
+
+    @classmethod
+    def clear(cls) -> None:
+        with cls._lock:
+            cls._ring.clear()

@@ -20,6 +20,19 @@ if not os.environ.get("GP_TEST_TPU"):
 
 import pytest  # noqa: E402
 
+# Deflake (round-2 verdict Weak #4 / ask #6): client timeouts in tests
+# scale by an env factor instead of being fixed small numbers that trip
+# under full-suite load.  Default scale is generous on small hosts (this
+# box has 1 core; a neighboring test's JIT compile can starve a node for
+# seconds); set GP_TEST_TIMEOUT_SCALE=1 on beefy machines for speed.
+_TSCALE = float(os.environ.get(
+    "GP_TEST_TIMEOUT_SCALE", "3" if (os.cpu_count() or 1) <= 2 else "1"))
+
+
+def tscale(t: float) -> float:
+    """Scale a test deadline by the environment factor."""
+    return t * _TSCALE
+
 
 @pytest.fixture(autouse=True)
 def _clean_config():
@@ -30,6 +43,9 @@ def _clean_config():
 
 @pytest.fixture(autouse=True)
 def _clean_profiler():
+    from gigapaxos_tpu.utils.instrument import RequestInstrumenter
     from gigapaxos_tpu.utils.profiler import DelayProfiler
     yield
     DelayProfiler.clear()
+    RequestInstrumenter.enabled = False
+    RequestInstrumenter.clear()

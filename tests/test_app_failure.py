@@ -21,6 +21,7 @@ from gigapaxos_tpu.paxos import packets as pkt
 from gigapaxos_tpu.paxos.client import PaxosClient
 from gigapaxos_tpu.paxos.interfaces import CounterApp
 from tests.test_e2e import make_cluster, shutdown
+from tests.conftest import tscale
 
 _LEN = struct.Struct("<I")
 
@@ -58,7 +59,7 @@ def test_transient_failure_retried_in_place(tmp_path, backend):
     try:
         for nd in nodes:
             nd.create_group("fl", (0, 1, 2))
-        cli = PaxosClient([addr_map[i] for i in range(3)], timeout=10)
+        cli = PaxosClient([addr_map[i] for i in range(3)], timeout=tscale(10))
         try:
             r = cli.send_request("fl", b"flaky-1")
             assert r.status == 0
@@ -82,7 +83,7 @@ def test_deterministic_failure_advances_and_caches(tmp_path, backend):
     try:
         for nd in nodes:
             nd.create_group("bm", (0, 1, 2))
-        cli = PaxosClient([addr_map[i] for i in range(3)], timeout=10)
+        cli = PaxosClient([addr_map[i] for i in range(3)], timeout=tscale(10))
         try:
             assert cli.send_request("bm", b"ok-1").status == 0
             r = cli.send_request("bm", b"boom-1")
@@ -114,7 +115,7 @@ def test_failed_request_retransmit_answered_from_cache(tmp_path, backend):
         entry = gkey % 3  # any replica works; pick deterministically
         client_id = 7777
         req_id = (client_id << 32) | 1
-        with socket.create_connection(addr_map[entry], timeout=10) as s:
+        with socket.create_connection(addr_map[entry], timeout=tscale(10)) as s:
             s.sendall(_LEN.pack(4) + struct.pack("<i", client_id))
             frame = pkt.Request(client_id, gkey, req_id, 0,
                                 b"boom-rt").encode()

@@ -12,6 +12,7 @@ from gigapaxos_tpu.paxos.backend import (ScalarBackend, ColumnarBackend,
 from gigapaxos_tpu.paxos.logger import (PaxosLogger, LogEntry,
                                         CheckpointRec, REC_ACCEPT,
                                         REC_DECIDE)
+from tests.conftest import tscale
 
 
 def test_grouptable_lifecycle():
@@ -230,7 +231,7 @@ def test_wal_compaction_runtime_bounded_and_recovery_exact(tmp_path):
         node = PaxosNode(0, addr_map, CounterApp(), d,
                          backend="native", capacity=1 << 8, window=16)
         node.start()
-        cli = PaxosClient([addr_map[0]], timeout=10)
+        cli = PaxosClient([addr_map[0]], timeout=tscale(10))
         digest = None
         try:
             assert node.create_group("wal", (0,))

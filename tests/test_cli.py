@@ -94,3 +94,62 @@ def test_server_client_chat_lifecycle(cluster):
     r = _cli(conf, "send", "room1", '{"op":"read","n":5}')
     assert "hello tpu" in r
     assert _cli(conf, "delete", "room1") == "deleted"
+
+
+def test_paxos_only_server_mode(tmp_path):
+    """--paxos-only boots bare PaxosNodes (ref: gigapaxos/PaxosServer):
+    no reconfigurators; GROUPS= pre-creates groups over all actives and
+    a plain PaxosClient drives requests."""
+    import socket as socket_mod
+
+    from gigapaxos_tpu.paxos.client import PaxosClient
+
+    socks = [socket_mod.socket() for _ in range(3)]
+    for s in socks:
+        s.bind(("127.0.0.1", 0))
+    ports = [s.getsockname()[1] for s in socks]
+    for s in socks:
+        s.close()
+    conf = tmp_path / "px.properties"
+    conf.write_text(
+        "".join(f"active.{i}=127.0.0.1:{ports[i]}\n" for i in range(3)) +
+        "APPLICATION=CounterApp\nCAPACITY=256\nWINDOW=8\n"
+        "BACKEND=native\nGROUPS=solo1,solo2\n")
+    env = dict(os.environ, PYTHONPATH=REPO)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-m", "gigapaxos_tpu.server",
+             "--config", str(conf), "--id", str(i),
+             "--logdir", str(tmp_path / "logs"), "--paxos-only"],
+            env=env, stdout=subprocess.DEVNULL, stderr=subprocess.PIPE)
+        for i in (0, 1, 2)]
+    try:
+        deadline = time.time() + 30
+        for port in ports:
+            while time.time() < deadline:
+                try:
+                    socket_mod.create_connection(
+                        ("127.0.0.1", port), timeout=0.2).close()
+                    break
+                except OSError:
+                    if any(p.poll() is not None for p in procs):
+                        _dump_and_fail(procs)
+                    time.sleep(0.1)
+            else:
+                _dump_and_fail(procs)
+        cli = PaxosClient([("127.0.0.1", p) for p in ports], timeout=15)
+        try:
+            for k in range(5):
+                assert cli.send_request("solo1", f"a{k}".encode()).status \
+                    == 0
+            assert cli.send_request("solo2", b"b").status == 0
+        finally:
+            cli.close()
+    finally:
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()

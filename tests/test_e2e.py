@@ -15,6 +15,7 @@ from gigapaxos_tpu.paxos.interfaces import CounterApp, KVApp, NoopApp
 from gigapaxos_tpu.paxos.manager import PaxosNode
 from gigapaxos_tpu.utils.config import Config
 from gigapaxos_tpu.paxos.paxosconfig import PC
+from tests.conftest import tscale
 
 
 def make_cluster(tmp_path, n=3, backend="columnar", app_cls=CounterApp,
@@ -51,7 +52,7 @@ def test_single_group_requests(tmp_path, backend):
     try:
         for nd in nodes:
             assert nd.create_group("g0", (0, 1, 2))
-        cli = PaxosClient([addr_map[i] for i in range(3)], timeout=10)
+        cli = PaxosClient([addr_map[i] for i in range(3)], timeout=tscale(10))
         try:
             for k in range(20):
                 resp = cli.send_request("g0", f"req-{k}".encode())
@@ -79,7 +80,7 @@ def test_many_groups_interleaved(tmp_path):
         for nd in nodes:
             for nm in names:
                 assert nd.create_group(nm, (0, 1, 2))
-        cli = PaxosClient([addr_map[i] for i in range(3)], timeout=10)
+        cli = PaxosClient([addr_map[i] for i in range(3)], timeout=tscale(10))
         try:
             for k in range(4):
                 for nm in names:
@@ -106,7 +107,7 @@ def test_kv_app(tmp_path):
     try:
         for nd in nodes:
             assert nd.create_group("kv", (0, 1, 2))
-        cli = PaxosClient([addr_map[i] for i in range(3)], timeout=10)
+        cli = PaxosClient([addr_map[i] for i in range(3)], timeout=tscale(10))
         try:
             import json
             r = cli.send_request("kv", b'{"op":"put","k":"a","v":"1"}')
@@ -128,7 +129,7 @@ def test_kv_app(tmp_path):
 def test_no_such_group(tmp_path):
     nodes, addr_map = make_cluster(tmp_path, n=1)
     try:
-        cli = PaxosClient([addr_map[0]], timeout=2)
+        cli = PaxosClient([addr_map[0]], timeout=tscale(2))
         try:
             with pytest.raises(TimeoutError):
                 cli.send_request("nope", b"x")
@@ -141,7 +142,7 @@ def test_no_such_group(tmp_path):
 def test_client_create_group_api(tmp_path):
     nodes, addr_map = make_cluster(tmp_path)
     try:
-        cli = PaxosClient([addr_map[i] for i in range(3)], timeout=10)
+        cli = PaxosClient([addr_map[i] for i in range(3)], timeout=tscale(10))
         try:
             assert cli.create_group("viaclient", (0, 1, 2), [0, 1, 2])
             resp = cli.send_request("viaclient", b"hello")

@@ -18,6 +18,7 @@ from gigapaxos_tpu.paxos.paxosconfig import PC
 from gigapaxos_tpu.utils.config import Config
 
 from tests.test_e2e import make_cluster, shutdown
+from tests.conftest import tscale
 
 
 def test_coordinator_failover(tmp_path):
@@ -31,7 +32,7 @@ def test_coordinator_failover(tmp_path):
             assert nd.create_group(name, (0, 1, 2))
         dead = group_key(name) % 3  # the deterministic initial coordinator
         cli = PaxosClient([addr_map[i] for i in range(3) if i != dead],
-                          timeout=4)
+                          timeout=tscale(4))
         for k in range(5):
             assert cli.send_request(name, f"pre-{k}".encode()).status == 0
         # let pings flow so survivors have last_heard entries, then crash
@@ -83,7 +84,7 @@ def test_failover_under_message_loss(tmp_path, backend):
             assert nd.create_group(name, (0, 1, 2))
         dead = group_key(name) % 3  # deterministic initial coordinator
         cli = PaxosClient([addr_map[i] for i in range(3) if i != dead],
-                          timeout=8, retransmit_s=0.25)
+                          timeout=tscale(8), retransmit_s=0.25)
         for k in range(3):
             assert cli.send_request(name, f"pre-{k}".encode()).status == 0
         time.sleep(0.5)  # pings flow; survivors know everyone
@@ -135,7 +136,7 @@ def test_crash_recovery_single_node(tmp_path):
     node = PaxosNode(0, addr_map, CounterApp(), str(tmp_path / "n0"),
                      capacity=1 << 8, window=16)
     node.start()
-    cli = PaxosClient([addr_map[0]], timeout=5)
+    cli = PaxosClient([addr_map[0]], timeout=tscale(5))
     try:
         assert node.create_group("solo", (0,))
         for k in range(12):
@@ -149,7 +150,7 @@ def test_crash_recovery_single_node(tmp_path):
     node2 = PaxosNode(0, addr_map, CounterApp(), str(tmp_path / "n0"),
                       capacity=1 << 8, window=16)
     node2.start()
-    cli2 = PaxosClient([addr_map[0]], timeout=5)
+    cli2 = PaxosClient([addr_map[0]], timeout=tscale(5))
     try:
         assert node2.app.count.get("solo") == 12, \
             f"recovered count {node2.app.count.get('solo')}"
@@ -181,7 +182,7 @@ def test_recovery_preserves_checkpoint_cut(tmp_path):
     node = PaxosNode(0, addr_map, CounterApp(), str(tmp_path / "n0"),
                      capacity=1 << 8, window=16)
     node.start()
-    cli = PaxosClient([addr_map[0]], timeout=5)
+    cli = PaxosClient([addr_map[0]], timeout=tscale(5))
     digest = None
     try:
         assert node.create_group("ck", (0,))

@@ -11,6 +11,7 @@ from gigapaxos_tpu.paxos.client import PaxosClient
 from gigapaxos_tpu.paxos.paxosconfig import PC
 from gigapaxos_tpu.utils.config import Config
 from tests.test_e2e import make_cluster, shutdown
+from tests.conftest import tscale
 
 
 @pytest.mark.parametrize("backend", ["scalar", "columnar"])
@@ -23,7 +24,7 @@ def test_pause_and_unpause_on_demand(tmp_path, backend):
             names = [f"pz{i}" for i in range(8)]
             for nd in nodes:
                 nd.create_groups([(n, (0, 1, 2)) for n in names])
-            cli = PaxosClient([addr_map[i] for i in range(3)], timeout=10)
+            cli = PaxosClient([addr_map[i] for i in range(3)], timeout=tscale(10))
             try:
                 for n in names:
                     assert cli.send_request(n, b"one").status == 0
@@ -83,7 +84,7 @@ def test_pause_survives_restart(tmp_path):
             for nd in nodes:
                 nd.create_group("cold", (0, 1, 2))
             cli = PaxosClient([addr_map[i] for i in range(3)],
-                              timeout=10)
+                              timeout=tscale(10))
             try:
                 assert cli.send_request("cold", b"x").status == 0
                 deadline = time.time() + 10
@@ -110,7 +111,7 @@ def test_pause_survives_restart(tmp_path):
             # cold after recovery: not in the table, but answers
             assert all(nd.table.by_name("cold") is None for nd in nodes2)
             cli = PaxosClient([addr_map[i] for i in range(3)],
-                              timeout=10)
+                              timeout=tscale(10))
             try:
                 assert cli.send_request("cold", b"y").status == 0
                 deadline = time.time() + 10
@@ -147,7 +148,7 @@ def test_unpause_after_coordinator_death_elects(tmp_path):
                 nd.create_group(name, (0, 1, 2))
             dead = group_key(name) % 3
             cli = PaxosClient(
-                [addr_map[i] for i in range(3) if i != dead], timeout=6)
+                [addr_map[i] for i in range(3) if i != dead], timeout=tscale(6))
             assert cli.send_request(name, b"a").status == 0
             # wait for the group to pause everywhere, then kill the coord
             deadline = time.time() + 10

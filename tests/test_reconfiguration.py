@@ -21,6 +21,7 @@ from gigapaxos_tpu.reconfiguration.node import NodeConfig
 from gigapaxos_tpu.reconfiguration.rcdb import (READY, WAIT_ACK_START,
                                                 ReconfiguratorDB)
 from gigapaxos_tpu.utils.config import Config
+from tests.conftest import tscale
 
 
 def free_ports(n):
@@ -130,7 +131,7 @@ def test_create_request_delete(tmp_path):
     nodes, cfg = make_cluster(tmp_path)
     try:
         async def body():
-            cli = ReconfigurableAppClient(1 << 16, cfg, timeout=10)
+            cli = ReconfigurableAppClient(1 << 16, cfg, timeout=tscale(10))
             try:
                 assert await cli.create("svcA", b"")
                 actives = await cli.get_actives("svcA")
@@ -163,7 +164,7 @@ def test_many_creates(tmp_path):
     nodes, cfg = make_cluster(tmp_path)
     try:
         async def body():
-            cli = ReconfigurableAppClient(1 << 16, cfg, timeout=15)
+            cli = ReconfigurableAppClient(1 << 16, cfg, timeout=tscale(15))
             try:
                 names = [f"svc{i}" for i in range(20)]
                 oks = await asyncio.gather(
@@ -184,7 +185,7 @@ def test_move_preserves_state(tmp_path):
     nodes, cfg = make_cluster(tmp_path, n_active=4)
     try:
         async def body():
-            cli = ReconfigurableAppClient(1 << 16, cfg, timeout=15)
+            cli = ReconfigurableAppClient(1 << 16, cfg, timeout=tscale(15))
             try:
                 assert await cli.create("mv", b"")
                 old = sorted(await cli.get_actives("mv"))
@@ -230,7 +231,7 @@ def test_concurrent_create_then_immediate_delete(tmp_path):
     nodes, cfg = make_cluster(tmp_path)
     try:
         async def body():
-            cli = ReconfigurableAppClient(1 << 16, cfg, timeout=15)
+            cli = ReconfigurableAppClient(1 << 16, cfg, timeout=tscale(15))
             try:
                 create_t = asyncio.create_task(cli.create("svcX", b""))
                 # race the delete against the in-flight create
@@ -297,7 +298,7 @@ def test_demand_driven_move(tmp_path):
         nd.start()
     try:
         async def body():
-            cli = ReconfigurableAppClient(1 << 16, cfg, timeout=15)
+            cli = ReconfigurableAppClient(1 << 16, cfg, timeout=tscale(15))
             try:
                 assert await cli.create("hotname", b"")
                 rcn = nodes[-1].reconfigurator
