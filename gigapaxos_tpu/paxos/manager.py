@@ -253,7 +253,11 @@ class PaxosNode:
         self.intake_rps = float(Config.get(PC.MAX_INTAKE_RPS))
         self._intake_tokens = self.intake_rps
         self._intake_ts = time.time()
-        RequestInstrumenter.enabled = bool(Config.get(PC.TRACE_REQUESTS))
+        if bool(Config.get(PC.TRACE_REQUESTS)):
+            # only-enable: a manual RequestInstrumenter.enabled = True
+            # (the documented runtime switch) must survive later node
+            # constructions; tests reset it via their fixture
+            RequestInstrumenter.enabled = True
         # failure detection (ref: gigapaxos/FailureDetection.java)
         self._last_heard: Dict[int, float] = {}
         self.ping_interval = float(Config.get(PC.PING_INTERVAL_S))
@@ -1162,6 +1166,19 @@ class PaxosNode:
         if live:
             self._handle_requests([], live)
 
+    def _intake_take(self, n: int = 1) -> bool:
+        """Take n tokens from the intake bucket; False = throttled."""
+        now = time.time()
+        self._intake_tokens = min(
+            self.intake_rps,
+            self._intake_tokens + (now - self._intake_ts) *
+            self.intake_rps)
+        self._intake_ts = now
+        if self._intake_tokens < n:
+            return False
+        self._intake_tokens -= n
+        return True
+
     def _intake_limit(self, sb: "_ReqSoA"):
         """Token-bucket intake limiter (ref: paxosutil/RateLimiter):
         admits up to the bucket's tokens, answers the rest status 1
@@ -1245,6 +1262,12 @@ class PaxosNode:
         # and any slow lanes shunted from above) ----
         lanes: List[Tuple[int, int, int, bytes, int]] = []  # row,req,fl,pl,en
         for o in reqs:
+            if self.intake_rps > 0 and not self._intake_take():
+                # the rate limit must hold on the per-object fallback
+                # path too (a malformed frame shunts whole chunks here)
+                self._route(o.sender, pkt.Response(
+                    self.id, o.gkey, o.req_id, 1, b""))
+                continue
             meta = self._lookup(o.gkey)
             if meta is None:
                 self._route(o.sender, pkt.Response(

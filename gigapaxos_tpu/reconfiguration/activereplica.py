@@ -42,13 +42,18 @@ class ActiveReplica:
 
     def __init__(self, node_id: int, addr_map: Dict[int, Tuple[str, int]],
                  reconfigurators: Tuple[int, ...], app: Replicable,
-                 logdir: str, demand_report_every: int = 100, **node_kw):
+                 logdir: str, demand_report_every: Optional[int] = None,
+                 **node_kw):
         self.id = node_id
         self.reconfigurators = tuple(reconfigurators)
         self.coordinator = PaxosReplicaCoordinator(app)
         self.node = PaxosNode(node_id, addr_map, self.coordinator, logdir,
                               **node_kw)
         self.coordinator.bind(self.node)
+        if demand_report_every is None:
+            from gigapaxos_tpu.reconfiguration.rcconfig import RC
+            from gigapaxos_tpu.utils.config import Config as _C
+            demand_report_every = int(_C.get(RC.DEMAND_REPORT_EVERY))
         self.demand_report_every = demand_report_every
         self._demand_acc: Dict[str, int] = {}
         # stops we have been asked for but whose group is still running:

@@ -143,28 +143,41 @@ class ReconfigurableAppClient:
         self._preferred.pop(name, None)
         return bool(b.get("ok"))
 
+    @staticmethod
+    def _wire_chunks(names: List[str]) -> List[List[str]]:
+        from gigapaxos_tpu.reconfiguration.rcconfig import RC
+        from gigapaxos_tpu.utils.config import Config
+        cb = int(Config.get(RC.CLIENT_BATCH))
+        return [list(names[at:at + cb])
+                for at in range(0, len(names), cb)] or [[]]
+
     async def create_names(self, names: List[str],
                            initial_state: bytes = b"",
                            timeout: Optional[float] = None) -> int:
         """Batched create (ref: batched CreateServiceName).  One control
-        round trip for the whole batch; the entry reconfigurator buckets
-        by owning RC group and aggregates.  Returns #names now READY."""
-        rid = self._rid()
-        b = rc.create_batch([[n, b64e(initial_state)] for n in names],
-                            rid)
-        resp = await self._control_t(b, timeout)
-        return int(resp.get("n_ok", 0))
+        round trip per RC.CLIENT_BATCH names; the entry reconfigurator
+        buckets each wire batch by owning RC group and aggregates.
+        Returns #names now READY."""
+        done = 0
+        for chunk in self._wire_chunks(list(names)):
+            b = rc.create_batch(
+                [[n, b64e(initial_state)] for n in chunk], self._rid())
+            resp = await self._control_t(b, timeout)
+            done += int(resp.get("n_ok", 0))
+        return done
 
     async def delete_names(self, names: List[str],
                            timeout: Optional[float] = None) -> int:
         """Batched delete; returns #names now gone."""
-        rid = self._rid()
-        resp = await self._control_t(rc.delete_batch(list(names), rid),
-                                     timeout)
+        done = 0
+        for chunk in self._wire_chunks(list(names)):
+            resp = await self._control_t(
+                rc.delete_batch(chunk, self._rid()), timeout)
+            done += int(resp.get("n_ok", 0))
         for n in names:
             self._actives_cache.pop(n, None)
             self._preferred.pop(n, None)
-        return int(resp.get("n_ok", 0))
+        return done
 
     async def _control_t(self, body: dict, timeout: Optional[float]):
         if timeout is None:

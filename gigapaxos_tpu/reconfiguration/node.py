@@ -25,10 +25,17 @@ class NodeConfig:
 
     actives: Dict[int, Tuple[str, int]]
     reconfigurators: Dict[int, Tuple[str, int]]
-    actives_per_name: int = 3
-    rc_group_size: int = 3
+    # None -> the RC config enum's layered default (rcconfig.RC)
+    actives_per_name: Optional[int] = None
+    rc_group_size: Optional[int] = None
 
     def __post_init__(self):
+        from gigapaxos_tpu.reconfiguration.rcconfig import RC
+        from gigapaxos_tpu.utils.config import Config
+        if self.actives_per_name is None:
+            self.actives_per_name = int(Config.get(RC.ACTIVES_PER_NAME))
+        if self.rc_group_size is None:
+            self.rc_group_size = int(Config.get(RC.RC_GROUP_SIZE))
         overlap = set(self.actives) & set(self.reconfigurators)
         if overlap:
             raise ValueError(f"ids in both roles: {overlap}")
@@ -67,7 +74,8 @@ class ReconfigurableNode:
 
     def __init__(self, node_id: int, config: NodeConfig,
                  app_factory: Callable[[], Replicable], logdir: str,
-                 demand_policy=None, demand_report_every: int = 100,
+                 demand_policy=None,
+                 demand_report_every: Optional[int] = None,
                  **node_kw):
         self.id = node_id
         self.config = config

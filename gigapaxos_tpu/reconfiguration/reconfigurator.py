@@ -85,7 +85,9 @@ class Reconfigurator:
         self._demand: Dict[str, int] = {}
         self.demand_policy = demand_policy
         self._last_retry = 0.0
-        self.retry_s = 1.0
+        from gigapaxos_tpu.reconfiguration.rcconfig import RC
+        from gigapaxos_tpu.utils.config import Config as _C
+        self.retry_s = float(_C.get(RC.RETRY_S))
 
     # -- lifecycle ---------------------------------------------------------
 
