@@ -188,11 +188,15 @@ class Transport:
                 peer = self._peers[dst] = _Peer()
                 peer.task = self._loop.create_task(self._writer_loop(dst))
             if peer.writer is not None and not peer.queue and \
-                    self.direct_write:
+                    self.direct_write and not peer.writer.is_closing():
                 # connected steady state: write straight into the asyncio
                 # transport buffer (the queue+writer-task hop costs a
                 # task wake per batch); backpressure via the transport's
-                # own write buffer against the same byte budget
+                # own write buffer against the same byte budget.  A
+                # closing writer falls through to the queue path, whose
+                # wake makes the writer task discover the dead socket
+                # and reconnect (direct writes alone would never notice:
+                # the write "succeeds" into a dying transport)
                 w = peer.writer
                 if w.transport.get_write_buffer_size() + len(payload) > \
                         self.max_queue_bytes:
@@ -277,8 +281,13 @@ class Transport:
             # connection to our node id (replies to unmapped ids)
             writer.write(_LEN.pack(4) + struct.pack("<i", self.id))
             # connections are bidirectional: the far side may send replies
-            # back over this link (client reply path), so read it too
+            # back over this link (client reply path), so read it too.
+            # The read side reaching EOF is ALSO our only prompt signal
+            # that the peer died (direct writes bypass this loop), so
+            # its completion kicks the wake event — the drain below then
+            # fails fast and we reconnect.
             rtask = self._loop.create_task(self._read_frames(reader))
+            rtask.add_done_callback(lambda _t: peer.wake.set())
             try:
                 while not self._closed:
                     while peer.queue:
@@ -286,6 +295,9 @@ class Transport:
                         peer.bytes_queued -= len(payload)
                         self._write(writer, payload, preframed, nframes)
                     await writer.drain()
+                    if writer.is_closing() or (rtask.done()
+                                               and not self._closed):
+                        break  # peer gone: reconnect
                     if not peer.queue:
                         peer.wake.clear()
                         await peer.wake.wait()

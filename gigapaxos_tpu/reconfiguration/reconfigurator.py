@@ -97,6 +97,14 @@ class Reconfigurator:
         # creates its copy, like actives do on StartEpoch)
         for g in self.my_groups():
             self.node.create_group(g, self.group_members(g), version=0)
+            # proactive anti-entropy for OUR record groups (there are
+            # only a handful): ops committed while this node was down
+            # would otherwise only arrive lazily with the next decision
+            # in each group — pull them now so recovered reconfigurators
+            # serve current records immediately
+            meta = self.node.table.by_name(g)
+            if meta is not None:
+                self.node._sync_if_gap(meta.row)
 
     def stop(self) -> None:
         self.node.stop()
