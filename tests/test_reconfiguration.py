@@ -467,3 +467,73 @@ def test_reconfigurator_crash_restart_recovers_records(tmp_path):
         run(phase3())
     finally:
         shutdown([nd for nd in nodes if nd not in dead])
+
+
+def test_active_crash_during_creates_epochs_complete(tmp_path):
+    """An active replica down during batched creates: epochs must reach
+    READY on majority AckStarts (2/3), and the revived active must be
+    brought into its groups by the age-gated start-epoch retries."""
+    import time as time_mod
+
+    from gigapaxos_tpu.paxos.paxosconfig import PC
+    Config.set(PC.PAUSE_IDLE_S, 0)
+    nodes, cfg = make_cluster(tmp_path)
+    dead = []
+    try:
+        victim_id = sorted(cfg.actives)[0]
+        victim = next(nd for nd in nodes if nd.id == victim_id)
+        victim.stop()
+        dead.append(victim)
+
+        async def create_phase():
+            cli = ReconfigurableAppClient(1 << 16, cfg,
+                                          timeout=tscale(20), retries=5)
+            try:
+                names = [f"acr{i}" for i in range(12)]
+                # majority (2 of 3 actives) must suffice for READY
+                assert await cli.create_names(names) == 12
+                r = await cli.send_request(names[0],
+                                          b'{"op":"put","k":"k","v":"1"}')
+                assert b"ok" in r
+                return names
+            finally:
+                await cli.close()
+        names = run(create_phase())
+
+        # revive the active over the same logdir; the reconfigurators'
+        # retry tick re-sends start_epoch batches for... nothing (all
+        # READY) — the revived node joins groups lazily via traffic, but
+        # its MEMBERSHIP was already in every epoch, so decided requests
+        # reach it once peers reconnect and it syncs on gaps
+        from gigapaxos_tpu.reconfiguration.node import ReconfigurableNode
+        from gigapaxos_tpu.paxos.interfaces import KVApp
+        revived = ReconfigurableNode(victim_id, cfg, KVApp,
+                                     str(tmp_path), capacity=1 << 10,
+                                     window=16)
+        revived.start()
+        nodes.append(revived)
+
+        async def after_phase():
+            cli = ReconfigurableAppClient((1 << 16) + 3, cfg,
+                                          timeout=tscale(20), retries=5)
+            try:
+                # writes keep landing with the full membership back
+                for nm in names[:4]:
+                    r = await cli.send_request(
+                        nm, b'{"op":"put","k":"k2","v":"2"}')
+                    assert b"ok" in r
+                # and brand-new creates now ack on all three actives
+                assert await cli.create_names(["acr-post"]) == 1
+            finally:
+                await cli.close()
+        deadline = time_mod.time() + tscale(30)
+        while True:
+            try:
+                run(after_phase())
+                break
+            except (TimeoutError, AssertionError):
+                if time_mod.time() > deadline:
+                    raise
+                time_mod.sleep(0.5)
+    finally:
+        shutdown([nd for nd in nodes if nd not in dead])
