@@ -63,6 +63,12 @@ FLAG_NOOP = 2
 FLAG_MISSING = 4
 
 
+def _no_cpu_clock():
+    """Stand-in for time.thread_time when PC.PROFILE_CPU is off —
+    update_total skips the CPU column for a None t0."""
+    return None
+
+
 @dataclass
 class _InFlight:
     """Coordinator-side in-flight proposal (dedupe + accept re-drive).
@@ -158,6 +164,10 @@ class PaxosNode:
         self.batch_coalesce = float(Config.get(PC.BATCH_COALESCE_S))
         self.batch_busy = int(Config.get(PC.BATCH_BUSY_ITEMS))
         self.checkpoint_interval = int(Config.get(PC.CHECKPOINT_INTERVAL))
+        # stage CPU accounting: thread_time() is a ~6us syscall, so the
+        # hot path only samples it when PC.PROFILE_CPU asks for it
+        self._ct = time.thread_time \
+            if bool(Config.get(PC.PROFILE_CPU)) else _no_cpu_clock
 
         # host-side per-row mirrors (the cold scalar state the reference
         # keeps in PaxosInstanceStateMachine fields).  Row-indexed numpy
@@ -832,11 +842,11 @@ class PaxosNode:
                 n_frames += len(nxt) if isinstance(nxt, list) else 1
             prev_items = n_frames
             t0 = time.monotonic()
-            c0 = time.thread_time()
+            c0 = self._ct()
             try:
                 decoded = self._decode_batch(batch)
                 t1 = time.monotonic()
-                c1 = time.thread_time()
+                c1 = self._ct()
                 DelayProfiler.update_total("w.decode", t0, len(batch),
                                            cpu_t0=c0)
                 self._process(decoded)
@@ -1080,7 +1090,7 @@ class PaxosNode:
         soas = by_type.pop(_ReqSoA, [])
         if reqs or props or soas:
             t0 = time.monotonic()
-            c0 = time.thread_time()
+            c0 = self._ct()
             self._handle_requests(reqs, props, soas)
             DelayProfiler.update_total(
                 "w.requests", t0,
@@ -1089,21 +1099,21 @@ class PaxosNode:
         accepts = by_type.pop(pkt.AcceptBatch, [])
         if accepts:
             t0 = time.monotonic()
-            c0 = time.thread_time()
+            c0 = self._ct()
             self._handle_accepts(accepts)
             DelayProfiler.update_total("w.accepts", t0, len(accepts),
                                        cpu_t0=c0)
         replies = by_type.pop(pkt.AcceptReplyBatch, [])
         if replies:
             t0 = time.monotonic()
-            c0 = time.thread_time()
+            c0 = self._ct()
             self._handle_accept_replies(replies)
             DelayProfiler.update_total("w.replies", t0, len(replies),
                                        cpu_t0=c0)
         commits = by_type.pop(pkt.CommitBatch, [])
         if commits:
             t0 = time.monotonic()
-            c0 = time.thread_time()
+            c0 = self._ct()
             self._handle_commits(commits)
             DelayProfiler.update_total("w.commits", t0, len(commits),
                                        cpu_t0=c0)
@@ -1426,16 +1436,12 @@ class PaxosNode:
         # winner mask is ONE native hash pass (ref: PaxosPacketBatcher).
         # Everything per-lane below is vectorized numpy over the batch —
         # the only Python-per-lane work left is the payload dict store.
-        gkeys = np.concatenate([np.asarray(o.gkey, np.uint64)
-                                for o in objs])
-        slots_all = np.concatenate([np.asarray(o.slot, np.int32)
-                                    for o in objs])
-        bals_all = np.concatenate([np.asarray(o.bal, np.int32)
-                                   for o in objs])
-        reqs_all = np.concatenate([
-            _merge_req(o.req_lo, o.req_hi) for o in objs])
-        send_all = np.concatenate([
-            np.full(len(o.gkey), o.sender, np.int32) for o in objs])
+        gkeys = _cat(objs, lambda o: np.asarray(o.gkey, np.uint64))
+        slots_all = _cat(objs, lambda o: np.asarray(o.slot, np.int32))
+        bals_all = _cat(objs, lambda o: np.asarray(o.bal, np.int32))
+        reqs_all = _cat(objs, lambda o: _merge_req(o.req_lo, o.req_hi))
+        send_all = _cat(objs, lambda o: np.full(len(o.gkey), o.sender,
+                                                np.int32))
         rows_all = self._rows_for_keys(gkeys)
         if self._fused is not None:
             now = time.time()
@@ -1446,11 +1452,20 @@ class PaxosNode:
             ai = np.flatnonzero(acked_m)
             pls = _lane_payloads(objs, ai)
             blobs = []
-            for k, i in enumerate(ai.tolist()):
-                blob = pls[k]
-                flags, payload = (blob[0], bytes(blob[1:])) if blob \
-                    else (0, b"")
-                self._store_payload(int(reqs_all[i]), flags, payload)
+            # inlined _store_payload (identical best-copy semantics):
+            # this is the one per-lane Python loop on the accept path,
+            # so every dict hop and numpy scalar conversion counts
+            P, PO = self._payloads, self._payloads_old
+            for blob, rid in zip(pls, reqs_all[ai].tolist()):
+                fl = blob[0] if blob else 0
+                cur = P.get(rid)
+                if cur is None:
+                    cur = PO.pop(rid, None)
+                    if cur is not None:
+                        P[rid] = cur
+                if cur is None or ((cur[0] & FLAG_MISSING)
+                                   and not (fl & FLAG_MISSING)):
+                    P[rid] = (fl, bytes(blob[1:]) if blob else b"")
                 blobs.append(blob if blob else b"\x00")
             wal_buf = native.encode_wal(
                 np.full(len(ai), REC_ACCEPT, np.uint8), gkeys[ai],
@@ -1533,16 +1548,12 @@ class PaxosNode:
     # -- accept replies (coordinator side) ------------------------------
 
     def _handle_accept_replies(self, objs: List) -> None:
-        gkeys = np.concatenate([np.asarray(o.gkey, np.uint64)
-                                for o in objs])
-        slots_a = np.concatenate([np.asarray(o.slot, np.int32)
-                                  for o in objs])
-        bals_a = np.concatenate([np.asarray(o.bal, np.int32)
-                                 for o in objs])
-        acked_a = np.concatenate([np.asarray(o.acked, np.uint8)
-                                  for o in objs])
-        send_a = np.concatenate([
-            np.full(len(o.gkey), o.sender, np.int32) for o in objs])
+        gkeys = _cat(objs, lambda o: np.asarray(o.gkey, np.uint64))
+        slots_a = _cat(objs, lambda o: np.asarray(o.slot, np.int32))
+        bals_a = _cat(objs, lambda o: np.asarray(o.bal, np.int32))
+        acked_a = _cat(objs, lambda o: np.asarray(o.acked, np.uint8))
+        send_a = _cat(objs, lambda o: np.full(len(o.gkey), o.sender,
+                                              np.int32))
         all_rows = self._rows_for_keys(gkeys)
         if self._fused is not None:
             newly, dec_req, dec_bal = self._fused.handle_replies(
@@ -1617,14 +1628,10 @@ class PaxosNode:
     # -- commits → execution -------------------------------------------
 
     def _handle_commits(self, objs: List) -> None:
-        gkeys = np.concatenate([np.asarray(o.gkey, np.uint64)
-                                for o in objs])
-        slots_a = np.concatenate([np.asarray(o.slot, np.int32)
-                                  for o in objs])
-        bals_a = np.concatenate([np.asarray(o.bal, np.int32)
-                                 for o in objs])
-        reqs_a = np.concatenate([
-            _merge_req(o.req_lo, o.req_hi) for o in objs])
+        gkeys = _cat(objs, lambda o: np.asarray(o.gkey, np.uint64))
+        slots_a = _cat(objs, lambda o: np.asarray(o.slot, np.int32))
+        bals_a = _cat(objs, lambda o: np.asarray(o.bal, np.int32))
+        reqs_a = _cat(objs, lambda o: _merge_req(o.req_lo, o.req_hi))
         all_rows = self._rows_for_keys(gkeys)
         self._commit_install(all_rows, slots_a, bals_a, reqs_a, gkeys)
 
@@ -1697,12 +1704,22 @@ class PaxosNode:
             return
         cur = int(self._cur[row])
         dec = self._dec[row]
+        # the busiest per-request Python loop in the system: every dict
+        # and attribute hop below runs once per decided request per
+        # replica, so the shared tables are bound to locals up front
+        P, PO = self._payloads, self._payloads_old
+        ER, RC = self._executed_recent, self._resp_cache
+        CW, PR = self._client_wait, self._proposed
+        n_exec = 0
         while cur in dec:
             req_id = dec[cur]
-            got = self._payload_pop(req_id)
+            got = P.pop(req_id, None)
+            old = PO.pop(req_id, None)
+            if got is None:
+                got = old
             if got is None or (got[0] & FLAG_MISSING):
                 if got is not None:
-                    self._payloads[req_id] = got  # keep the placeholder
+                    P[req_id] = got  # keep the placeholder
                 # we never saw the accept (gap): ask peers, stop here
                 self._sync_if_gap(row)
                 break
@@ -1748,8 +1765,8 @@ class PaxosNode:
                     resp, status = b'{"err":"app exception"}', 4
                 if flags & FLAG_STOP:
                     self._group_stopped.add(row)
-            self.n_executed += 1
-            self._proposed.pop(req_id, None)
+            n_exec += 1
+            PR.pop(req_id, None)
             if RequestInstrumenter.enabled:
                 RequestInstrumenter.record(req_id, "exec", self.id)
             if status in (0, 4):
@@ -1761,13 +1778,14 @@ class PaxosNode:
                 # retryable in the next epoch — caching it would answer a
                 # retransmit with an empty "success", i.e. a silently
                 # lost write.
-                self._executed_recent[req_id] = 1
-                self._resp_cache[req_id] = (status, resp)
-            waiter = self._client_wait.pop(req_id, None)
+                ER[req_id] = 1
+                RC[req_id] = (status, resp)
+            waiter = CW.pop(req_id, None)
             if waiter is not None:
                 self._route(waiter[0], pkt.Response(
                     self.id, meta.gkey, req_id, status, resp))
             cur += 1
+        self.n_executed += n_exec
         self._cur[row] = cur
         # (device cursor advances in the commit kernel; no set_cursor here)
         # checkpoint cut (ref: extractExecuteAndCheckpoint, every ~400)
@@ -2211,6 +2229,14 @@ def _np_jsonable(o):
     raise TypeError(f"not jsonable: {type(o)}")
 
 
+def _cat(objs, fn):
+    """Gather one field across a packet list: the single-packet case
+    (the common trickle shape) skips the concatenate copy."""
+    if len(objs) == 1:
+        return fn(objs[0])
+    return np.concatenate([fn(o) for o in objs])
+
+
 def _merge_req(lo, hi) -> np.ndarray:
     """Vectorized (lo32, hi32) -> u64 request ids for a whole batch."""
     lo = np.ascontiguousarray(lo, np.int32).view(np.uint32).astype(
@@ -2222,10 +2248,13 @@ def _merge_req(lo, hi) -> np.ndarray:
 
 def _lane_payloads(objs, sel) -> List[bytes]:
     """Payload blobs of the selected global lanes across a packet list."""
-    all_pls: List[bytes] = []
-    for o in objs:
-        all_pls.extend(o.payloads or (b"",) * len(o.gkey))
-    return [all_pls[int(i)] for i in sel]
+    if len(objs) == 1:
+        all_pls = objs[0].payloads or (b"",) * len(objs[0].gkey)
+    else:
+        all_pls = []
+        for o in objs:
+            all_pls.extend(o.payloads or (b"",) * len(o.gkey))
+    return [all_pls[i] for i in sel.tolist()]
 
 
 def _split_reqs(reqs: List[int]) -> Tuple[np.ndarray, np.ndarray]:

@@ -73,6 +73,11 @@ class PC(ConfigKey):
     # beyond this many per second are answered status 1 ("retry") at the
     # door instead of admitted to the pipeline; 0 disables
     MAX_INTAKE_RPS = 0
+    # per-stage CPU-seconds accounting (DelayProfiler update_total
+    # cpu column).  Off by default: thread_time() is a real syscall
+    # (~6 us — no vDSO for CLOCK_THREAD_CPUTIME_ID) and the worker
+    # makes ~12 of these per pass, a measurable tax on trickle batches
+    PROFILE_CPU = False
     # per-request cross-stage tracing (ref: paxosutil/
     # RequestInstrumenter at FINE level): records recv/prop/acc/dec/exec
     # events into utils.instrument.RequestInstrumenter's global ring

@@ -31,11 +31,23 @@ from typing import Any, Dict, Optional
 
 
 class ConfigKey(enum.Enum):
-    """Base class for config enums: member value = typed default."""
+    """Base class for config enums: member value = typed default.
+
+    Members are keyed by NAME and never aliased: a plain Enum folds
+    members whose values compare equal into one (``False == 0``), which
+    silently fused unrelated knobs whose defaults coincide — setting
+    one set them all.  ``_value_`` is a unique ordinal; the declared
+    default lives beside it."""
+
+    def __new__(cls, default: Any):
+        obj = object.__new__(cls)
+        obj._value_ = len(cls.__members__)  # unique → no alias folding
+        obj._default_value = default
+        return obj
 
     @property
     def default(self) -> Any:
-        return self.value
+        return self._default_value
 
 
 def _coerce(raw: str, default: Any) -> Any:

@@ -20,6 +20,30 @@ def test_defaults():
     assert Config.get(TC.FLAG) is False
 
 
+def test_equal_defaults_do_not_alias():
+    """Members with equal defaults (False == 0 == 0.0) must stay
+    distinct — a plain Enum folds them into one member, so setting one
+    knob would silently set every knob whose default coincides."""
+
+    class TA(ConfigKey):
+        A = False
+        B = 0
+        C = 0.0
+        D = False
+
+    assert len(list(TA)) == 4
+    assert TA.A is not TA.B and TA.B is not TA.C and TA.A is not TA.D
+    assert TA.A.default is False and TA.B.default == 0
+    assert isinstance(TA.C.default, float)
+    Config.set(TA.A, True)
+    try:
+        assert Config.get(TA.A) is True
+        assert Config.get(TA.B) == 0
+        assert Config.get(TA.D) is False
+    finally:
+        Config.unset(TA.A)
+
+
 def test_programmatic_override():
     Config.set(TC.BATCH_SIZE, 8)
     assert Config.get(TC.BATCH_SIZE) == 8
