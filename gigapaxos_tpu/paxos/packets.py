@@ -30,6 +30,7 @@ are optional per type.
 
 from __future__ import annotations
 
+import functools
 import hashlib
 import struct
 from dataclasses import dataclass, field
@@ -39,8 +40,12 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 
+@functools.lru_cache(maxsize=1 << 18)
 def group_key(name: str) -> int:
-    """Stable 64-bit key for a group name (blake2b-8)."""
+    """Stable 64-bit key for a group name (blake2b-8).  Memoized: the
+    control plane re-derives a name's key at every FSM stage (~80 calls
+    per create under churn), and the hash dominates its profile; LRU
+    keeps hot long-lived names when churn floods the cache."""
     return int.from_bytes(
         hashlib.blake2b(name.encode(), digest_size=8).digest(), "little")
 

@@ -196,10 +196,18 @@ class ActiveReplica:
 
     def _tick(self) -> None:
         # answer pending stops whose stop request has now executed; acks
-        # batch per destination reconfigurator (the churn path)
+        # batch per destination reconfigurator (the churn path).
+        # Event-driven: only names whose stop executed since the last
+        # tick are examined — a full _pending_stops scan per tick was
+        # O(pending) per worker batch and went quadratic under delete
+        # waves.  A stop that executes before its StopEpoch arrives is
+        # covered by _handle_stop_epoch's stopped_state() check.
         ack_by_dst: Dict[int, list] = {}
-        for name, (epoch, sender, _ts) in list(
-                self._pending_stops.items()):
+        for name in self.coordinator.drain_newly_stopped():
+            ent = self._pending_stops.get(name)
+            if ent is None:
+                continue
+            epoch, sender, _ts = ent
             done = self.coordinator.stopped_state(name)
             if done is not None and done[0] >= epoch:
                 del self._pending_stops[name]

@@ -9,6 +9,7 @@ ring with virtual nodes so that churn in the node set moves few names.
 from __future__ import annotations
 
 import bisect
+import functools
 from typing import List, Sequence, Tuple
 
 # one name-hash primitive for the whole framework (byte order is
@@ -35,12 +36,14 @@ class ConsistentHashing:
         self._ring = ring
         self._points = [p for p, _ in ring]
         self._nodes = sorted(set(nodes))
+        # placement cache: the FSM asks for the same name's placement at
+        # every stage.  A fresh per-instance lru_cache is built here
+        # because any ring change can move any name; LRU eviction keeps
+        # hot long-lived names when churn floods it.
+        self._cached_walk = functools.lru_cache(maxsize=1 << 18)(
+            self._ring_walk)
 
-    def replicated_servers(self, name: str, k: int) -> List[int]:
-        """The k distinct nodes clockwise from hash(name)."""
-        if not self._ring:
-            return []
-        k = min(k, len(self._nodes))
+    def _ring_walk(self, name: str, k: int) -> Tuple[int, ...]:
         out: List[int] = []
         i = bisect.bisect(self._points, _h(name))
         n = len(self._ring)
@@ -50,7 +53,13 @@ class ConsistentHashing:
                 out.append(node)
                 if len(out) == k:
                     break
-        return out
+        return tuple(out)
+
+    def replicated_servers(self, name: str, k: int) -> List[int]:
+        """The k distinct nodes clockwise from hash(name)."""
+        if not self._ring:
+            return []
+        return list(self._cached_walk(name, min(k, len(self._nodes))))
 
     def server(self, name: str) -> int:
         return self.replicated_servers(name, 1)[0]

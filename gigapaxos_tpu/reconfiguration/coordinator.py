@@ -11,7 +11,7 @@ from __future__ import annotations
 
 import abc
 import threading
-from typing import Dict, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 from gigapaxos_tpu.paxos.interfaces import Replicable
 
@@ -43,6 +43,11 @@ class PaxosReplicaCoordinator(AbstractReplicaCoordinator, Replicable):
         self.node = None  # set by bind()
         # name -> (epoch, final_state) captured at stop execution
         self._stopped: Dict[str, Tuple[int, bytes]] = {}
+        # stop-execution events since the last drain: lets the active
+        # replica ack exactly the names that just stopped instead of
+        # rescanning every pending stop per tick (O(pending) per batch
+        # went quadratic under churn waves of thousands of deletes)
+        self._newly_stopped: List[str] = []
         # names whose current epoch is stopped: reject new requests
         self._lock = threading.Lock()
         self.demand: Dict[str, int] = {}  # name -> request count (demand)
@@ -66,6 +71,7 @@ class PaxosReplicaCoordinator(AbstractReplicaCoordinator, Replicable):
             epoch = meta.version if meta else 0
             with self._lock:
                 self._stopped[name] = (epoch, final)
+                self._newly_stopped.append(name)
             return b""
         return self.app.execute(name, req_id, payload, False)
 
@@ -142,6 +148,15 @@ class PaxosReplicaCoordinator(AbstractReplicaCoordinator, Replicable):
     def stopped_state(self, name: str) -> Optional[Tuple[int, bytes]]:
         with self._lock:
             return self._stopped.get(name)
+
+    def drain_newly_stopped(self) -> List[str]:
+        """Names whose stop executed since the last call (see field
+        comment; consumed by ``ActiveReplica._tick``)."""
+        if not self._newly_stopped:
+            return []
+        with self._lock:
+            out, self._newly_stopped = self._newly_stopped, []
+            return out
 
     def drain_demand(self) -> Dict[str, int]:
         with self._lock:

@@ -19,7 +19,7 @@ from __future__ import annotations
 
 import base64
 import json
-from dataclasses import asdict, dataclass, field
+from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional
 
 from gigapaxos_tpu.paxos.interfaces import Replicable
@@ -43,7 +43,14 @@ class RCRecord:
     deleting: bool = False
 
     def to_json(self) -> dict:
-        return asdict(self)
+        # hand-rolled: dataclasses.asdict recurses via deep-copy helpers
+        # (~15 internal calls per record) and dominated the churn
+        # profile; every field here is a flat scalar or int list
+        return {"name": self.name, "epoch": self.epoch,
+                "state": self.state, "actives": list(self.actives),
+                "new_actives": list(self.new_actives),
+                "prev_actives": list(self.prev_actives),
+                "init_b64": self.init_b64, "deleting": self.deleting}
 
     @classmethod
     def from_json(cls, d: dict) -> "RCRecord":

