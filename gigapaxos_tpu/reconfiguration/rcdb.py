@@ -19,7 +19,7 @@ from __future__ import annotations
 
 import base64
 import json
-from dataclasses import dataclass, field
+from dataclasses import dataclass, field, fields
 from typing import Callable, Dict, List, Optional
 
 from gigapaxos_tpu.paxos.interfaces import Replicable
@@ -55,6 +55,14 @@ class RCRecord:
     @classmethod
     def from_json(cls, d: dict) -> "RCRecord":
         return cls(**d)
+
+
+# drift guard: the hand-rolled to_json must cover every dataclass field
+# — a field added later but missed there would serialize fine and then
+# silently restore to its default across checkpoint/restore
+assert (set(RCRecord("", 0, "", []).to_json())
+        == {f.name for f in fields(RCRecord)}), \
+    "RCRecord.to_json out of sync with its fields"
 
 
 class ReconfiguratorDB(Replicable):

@@ -153,3 +153,21 @@ def test_paxos_only_server_mode(tmp_path):
                 p.wait(timeout=10)
             except subprocess.TimeoutExpired:
                 p.kill()
+
+
+def test_multiproc_throughput_mode(tmp_path):
+    """The --multiproc bench path: replicas as real OS processes, the
+    windowed load generator driving them (smoke-sized run)."""
+    import argparse
+
+    from gigapaxos_tpu.testing.main import throughput_multiproc
+
+    args = argparse.Namespace(
+        nodes=3, groups=32, requests=600, concurrency=64,
+        backend="native", capacity=256, window=8, sync_wal=False,
+        logdir=str(tmp_path))
+    out = throughput_multiproc(args)
+    assert out["info"]["ok"] == 600
+    assert out["info"]["errors"] == 0
+    assert out["value"] > 0
+    assert out["info"]["latency_point"]["throughput_rps"] > 0
