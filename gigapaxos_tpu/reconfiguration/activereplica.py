@@ -174,6 +174,19 @@ class ActiveReplica:
                     now - prev[2] < 2.0:
                 self._pending_stops[nm] = (prev[0], sender, prev[2])
                 continue  # in flight: don't re-inject on retry waves
+            # only the group's boot coordinator injects on first sight:
+            # every member proposing the same stop triples the request
+            # traffic (two of three are dedup-dropped at the
+            # coordinator, but only after riding the per-object slow
+            # path).  Non-preferred members record the pending stop and
+            # inject only if it is still unexecuted ~2s later — the
+            # dead-coordinator fallback, reached via the RC re-drive
+            # waves.
+            preferred = meta.members[meta.gkey % len(meta.members)] \
+                == self.node.id
+            if prev is None and not preferred:
+                self._pending_stops[nm] = (epoch, sender, now)
+                continue
             self._pending_stops[nm] = (epoch, sender, now)
             self.node._inq.put(pkt.Request(
                 self.id, meta.gkey, stop_req_id(nm, epoch), FLAG_STOP,

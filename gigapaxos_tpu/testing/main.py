@@ -79,7 +79,10 @@ def throughput_multiproc(args) -> dict:
         os.path.dirname(os.path.abspath(__file__))))
     ports = free_ports(args.nodes)
     groups = [f"g{i}" for i in range(args.groups)]
-    tmp = tempfile.mkdtemp(prefix="gp_mp_")
+    # honor --logdir for post-mortems; only a self-made dir is removed
+    tmp = args.logdir or tempfile.mkdtemp(prefix="gp_mp_")
+    own_tmp = args.logdir is None
+    os.makedirs(tmp, exist_ok=True)
     conf = os.path.join(tmp, "gp.properties")
     with open(conf, "w") as f:
         for i, port in enumerate(ports):
@@ -89,18 +92,21 @@ def throughput_multiproc(args) -> dict:
                 f"GROUPS={','.join(groups)}\n")
     env = dict(os.environ, PYTHONPATH=repo,
                GP_PC_SYNC_WAL="1" if args.sync_wal else "0")
-    # stderr goes to files, not pipes: an undrained pipe blocks a chatty
-    # replica after ~64KB of warnings and silently stalls the bench
-    errs = [open(os.path.join(tmp, f"node{i}.err"), "wb")
-            for i in range(args.nodes)]
-    procs = [subprocess.Popen(
-        [sys.executable, "-m", "gigapaxos_tpu.server", "--config", conf,
-         "--id", str(i), "--app", "NoopApp", "--paxos-only",
-         "--logdir", os.path.join(tmp, "logs")],
-        env=env, stdout=subprocess.DEVNULL, stderr=errs[i])
-        for i in range(args.nodes)]
     servers = [("127.0.0.1", p) for p in ports]
+    errs: list = []
+    procs: list = []
     try:
+        for i in range(args.nodes):
+            # stderr goes to files, not pipes: an undrained pipe blocks
+            # a chatty replica after ~64KB of warnings and stalls the
+            # bench.  Spawn INSIDE the try: a mid-list Popen failure
+            # must still tear down the replicas already running.
+            errs.append(open(os.path.join(tmp, f"node{i}.err"), "wb"))
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m", "gigapaxos_tpu.server",
+                 "--config", conf, "--id", str(i), "--app", "NoopApp",
+                 "--paxos-only", "--logdir", os.path.join(tmp, "logs")],
+                env=env, stdout=subprocess.DEVNULL, stderr=errs[-1]))
         deadline = time.time() + 60
         for port in ports:
             while True:
@@ -149,8 +155,9 @@ def throughput_multiproc(args) -> dict:
                 p.kill()
         for e in errs:
             e.close()
-        import shutil
-        shutil.rmtree(tmp, ignore_errors=True)
+        if own_tmp:
+            import shutil
+            shutil.rmtree(tmp, ignore_errors=True)
 
 
 def mode_churn(args) -> dict:
