@@ -537,3 +537,52 @@ def test_active_crash_during_creates_epochs_complete(tmp_path):
                 time_mod.sleep(0.5)
     finally:
         shutdown([nd for nd in nodes if nd not in dead])
+
+
+def test_delete_with_boot_coordinator_down(tmp_path):
+    """Deletes must complete when a group's BOOT coordinator active is
+    dead: only that member injects the epoch-stop on first sight (the
+    single-injector optimization), so the survivors' deferred fallback
+    injection (~2s) plus the engine's coordinator re-election must carry
+    the stop round.  Creating with all actives up first pins epoch-0
+    membership to all three."""
+    Config.set(PC.FAILURE_TIMEOUT_S, 1.0)
+    nodes, cfg = make_cluster(tmp_path)
+    dead = []
+    try:
+        names = [f"dcd{i}" for i in range(8)]
+
+        async def create_phase():
+            cli = ReconfigurableAppClient((1 << 17) + 1, cfg,
+                                          timeout=tscale(20), retries=5)
+            try:
+                assert await cli.create_names(names) == 8
+            finally:
+                await cli.close()
+        run(create_phase())
+
+        # kill one active: some of the 8 names have it as their boot
+        # coordinator (members[gkey % 3]), which exercises both the
+        # preferred-injector path (alive coordinator) and the deferred
+        # fallback (dead coordinator) in one delete wave
+        victim_id = sorted(cfg.actives)[0]
+        victim = next(nd for nd in nodes if nd.id == victim_id)
+        victim.stop()
+        dead.append(victim)
+        time.sleep(tscale(1.5))  # let suspicion establish
+
+        async def delete_phase():
+            cli = ReconfigurableAppClient((1 << 17) + 2, cfg,
+                                          timeout=tscale(40), retries=8)
+            try:
+                assert await cli.delete_names(names) == 8
+                try:
+                    await cli.get_actives(names[0])
+                    assert False, "deleted name still resolvable"
+                except KeyError:
+                    pass
+            finally:
+                await cli.close()
+        run(delete_phase())
+    finally:
+        shutdown([nd for nd in nodes if nd not in dead])
