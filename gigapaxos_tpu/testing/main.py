@@ -9,6 +9,8 @@ the repo root):
 - ``churn``       config 4: group create/delete per second
 - ``failover``    config 5: 5-replica quorum, coordinator killed
   mid-load (prepare-heavy re-election), recovery measured
+- ``scale``       the "giga" capability: N groups live in ONE node
+  (batched creates/s, resident bytes/group, tail-group liveness)
 
 Usage::
 
@@ -278,6 +280,62 @@ def churn_via_reconfigurator(args) -> dict:
             nd.stop()
 
 
+def mode_scale(args) -> dict:
+    """The "giga" capability in the LIVE node runtime (not the storm
+    kernel): create --requests groups in one PaxosNode through the
+    batched create path, report create rate and resident bytes per
+    group, then prove the node still serves a request on the last
+    group created."""
+    import resource
+    import sys as sys_mod
+
+    from gigapaxos_tpu.paxos.client import PaxosClient
+    from gigapaxos_tpu.paxos.interfaces import NoopApp
+    from gigapaxos_tpu.paxos.manager import PaxosNode
+    from gigapaxos_tpu.testing.harness import free_ports
+
+    n = max(1, args.requests)
+    addr = {0: ("127.0.0.1", free_ports(1)[0])}
+    node = PaxosNode(0, addr, NoopApp(), args.logdir,
+                     backend=args.backend,
+                     capacity=max(args.capacity, n),  # table must fit n
+                     window=args.window)
+    node.start()
+    try:
+        rss0 = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+        t0 = time.perf_counter()
+        made = 0
+        batch = 16384
+        for at in range(0, n, batch):
+            made += node.create_groups(
+                [(f"m{i}", (0,)) for i in range(at, min(at + batch, n))])
+        wall = time.perf_counter() - t0
+        rss1 = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+        assert made == n, (
+            f"only {made}/{n} created — reused --logdir with existing "
+            "groups? scale mode needs a fresh log directory")
+        # ru_maxrss is KB on Linux, bytes on macOS
+        rss_kb = (rss1 - rss0) / (1024 if sys_mod.platform == "darwin"
+                                  else 1)
+        cli = PaxosClient([addr[0]], timeout=60)
+        try:
+            status = cli.send_request(f"m{n - 1}", b"ping").status
+        finally:
+            cli.close()
+        assert status == 0, f"request on group m{n - 1} failed: {status}"
+        return {
+            "metric": f"live-runtime group capacity: {n} groups, one "
+                      f"node ({args.backend})",
+            "value": round(made / wall, 1), "unit": "creates/s",
+            "info": {"groups": made, "wall_s": round(wall, 2),
+                     "rss_delta_mb": round(rss_kb / 1024, 1),
+                     "bytes_per_group": round(rss_kb * 1024 / made),
+                     "tail_request_status": status},
+        }
+    finally:
+        node.stop()
+
+
 def mode_failover(args) -> dict:
     emu = PaxosEmulation(args.logdir, n_nodes=5, n_groups=args.groups,
                          group_size=5, backend=args.backend,
@@ -317,7 +375,8 @@ def main(argv=None) -> int:
 
     jax.config.update("jax_platforms", "cpu")
     p = argparse.ArgumentParser(prog="gigapaxos_tpu.testing.main")
-    p.add_argument("mode", choices=["throughput", "churn", "failover"])
+    p.add_argument("mode",
+                   choices=["throughput", "churn", "failover", "scale"])
     p.add_argument("--nodes", type=int, default=3)
     p.add_argument("--groups", type=int, default=1000)
     p.add_argument("--requests", type=int, default=20000)
@@ -343,7 +402,7 @@ def main(argv=None) -> int:
     if args.logdir is None:
         args.logdir = tempfile.mkdtemp(prefix="gp_bench_")
     out = {"throughput": mode_throughput, "churn": mode_churn,
-           "failover": mode_failover}[args.mode](args)
+           "failover": mode_failover, "scale": mode_scale}[args.mode](args)
     print(json.dumps(out))
     return 0
 

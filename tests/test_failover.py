@@ -217,7 +217,6 @@ def test_torture_loss_crash_churn(tmp_path):
     Config.set(PC.FAILURE_TIMEOUT_S, 1.0)
     nodes, addr_map = make_cluster(tmp_path, backend="native")
     cli = None
-    revived = None
     try:
         groups = [f"tort{i}" for i in range(24)]
         side = [f"side{i}" for i in range(40)]
@@ -307,5 +306,4 @@ def test_torture_loss_crash_churn(tmp_path):
     finally:
         if cli:
             cli.close()
-        shutdown([nd for nd in nodes if nd is not None
-                  and not nd._stopping])
+        shutdown([nd for nd in nodes if not nd._stopping])
