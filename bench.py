@@ -271,6 +271,7 @@ def main():
         line = res.stdout.decode().strip().splitlines()[-1] \
             if res.stdout.strip() else ""
         if res.returncode == 0 and line.startswith("{"):
+            _record_tpu_last_good(line)
             print(line)
             return 0
         reason = f"primary run failed rc={res.returncode}"
@@ -292,6 +293,28 @@ def main():
         return 0
     sys.stderr.write(res.stderr.decode()[-2000:])
     return 1
+
+
+def _record_tpu_last_good(line: str) -> None:
+    """Persist the most recent REAL-accelerator bench line to
+    BENCH_TPU_LAST_GOOD.json.  The remote TPU tunnel on this host can
+    wedge for hours (the watchdog then reports a labeled host-XLA
+    fallback); this file keeps the genuine TPU measurement traceable
+    when a later run lands during an outage."""
+    try:
+        out = json.loads(line)
+        if out.get("info", {}).get("platform", "cpu") == "cpu":
+            return
+        out["recorded_at"] = time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                           time.gmtime())
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "BENCH_TPU_LAST_GOOD.json")
+        tmp = path + f".tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(out, f)
+        os.replace(tmp, path)  # atomic: never corrupt the prior record
+    except (ValueError, OSError):
+        pass  # recording is best-effort; never break the bench output
 
 
 def run_bench(args) -> dict:
