@@ -317,6 +317,26 @@ class PaxosLogger:
                               tuple(json.loads(r[3])), r[4], r[5])
                 for r in rows]
 
+    def checkpoints_for(self, gkeys: List[int]) -> List[CheckpointRec]:
+        """Checkpoint records for exactly these groups, chunked IN
+        queries (SQLite's default bound-variable cap is 999) — recovery
+        uses this to avoid materializing every state blob in the table
+        (paused groups' checkpoints can dominate at million-group
+        scale)."""
+        out: List[CheckpointRec] = []
+        chunk = 500
+        with self._db_lock:
+            for at in range(0, len(gkeys), chunk):
+                part = [_signed(g) for g in gkeys[at:at + chunk]]
+                marks = ",".join("?" * len(part))
+                out.extend(self._db.execute(
+                    "SELECT gkey,name,version,members,slot,state "
+                    f"FROM checkpoints WHERE gkey IN ({marks})",
+                    part).fetchall())
+        return [CheckpointRec(_unsigned(r[0]), r[1], r[2],
+                              tuple(json.loads(r[3])), r[4], r[5])
+                for r in out]
+
     def delete_checkpoint(self, gkey: int) -> None:
         with self._db_lock:
             self._db.execute("DELETE FROM checkpoints WHERE gkey=?",

@@ -410,26 +410,9 @@ class PaxosNode:
             raise
         if not metas:
             return 0
-        coords = [m.members[m.gkey % len(m.members)] for m in metas]
-        bals = [pack_ballot(0, c) for c in coords]
-        self.backend.create(
-            np.asarray([m.row for m in metas], np.int32),
-            np.asarray([len(m.members) for m in metas], np.int32),
-            np.full(len(metas), version, np.int32),
-            np.asarray(bals, np.int32),
-            np.asarray([c == self.id for c in coords]))
-        now = time.time()
-        for meta, bal in zip(metas, bals):
-            self._bal[meta.row] = bal
-            self._cur[meta.row] = 0
-            self._dec[meta.row] = {}
-            self._ckpt[meta.row] = -1
-            # idle-from-birth groups must still be pause-eligible
-            self._la[meta.row] = now
-            self._member_mat[meta.row] = -1
-            self._member_mat[meta.row, :len(meta.members)] = meta.members
-            self._row_gkey[meta.row] = meta.gkey
-            if initial_state:
+        self._install_rows(metas, self_coord=True, now=time.time())
+        if initial_state:
+            for meta in metas:
                 self.app.restore(meta.name, initial_state)
         if durable:
             self.logger.put_groups(
@@ -439,6 +422,34 @@ class PaxosNode:
                                self.app.checkpoint(m.name))
                  for m in metas])
         return len(metas)
+
+    def _install_rows(self, metas: List, self_coord: bool,
+                      now: float) -> None:
+        """Batched device-row + host-mirror install for freshly created
+        table metas — shared by ``create_groups`` and ``_recover`` so
+        the row invariants live in one place.  ``self_coord=False``
+        (recovery) starts every group promised to its boot coordinator
+        but NEVER coordinating until re-elected (safe default)."""
+        coords = [m.members[m.gkey % len(m.members)] for m in metas]
+        bals = np.asarray([pack_ballot(0, c) for c in coords], np.int32)
+        rows = np.asarray([m.row for m in metas], np.int32)
+        self.backend.create(
+            rows,
+            np.asarray([len(m.members) for m in metas], np.int32),
+            np.asarray([m.version for m in metas], np.int32),
+            bals,
+            np.asarray([self_coord and c == self.id for c in coords]))
+        self._bal[rows] = bals
+        self._cur[rows] = 0
+        self._ckpt[rows] = -1
+        # idle-from-birth groups must still be pause-eligible
+        self._la[rows] = now
+        self._member_mat[rows] = -1
+        for m in metas:
+            self._group_stopped.discard(m.row)  # recycled rows
+            self._dec[m.row] = {}
+            self._member_mat[m.row, :len(m.members)] = m.members
+            self._row_gkey[m.row] = m.gkey
 
     def delete_group(self, name: str) -> bool:
         return self.delete_groups([name]) == 1
@@ -2144,40 +2155,37 @@ class PaxosNode:
         if not groups:
             return
         t0 = time.time()
+        # BATCHED rebuild (one backend call, one checkpoint query): the
+        # per-group form — one 1-lane device create + one sqlite SELECT
+        # each — measured ~52us/group, i.e. ~50s of boot at 1M groups
+        metas = []
         for gkey, name, version, members in groups:
-            if gkey in self._paused:
+            if gkey in self._paused or self.table.by_key(gkey):
                 continue
-            meta_exists = self.table.by_key(gkey)
-            if meta_exists:
-                continue
-            meta = self.table.create(name, members, version)
-            coord = members[gkey % len(members)]
-            init_bal = pack_ballot(0, coord)
-            self.backend.create(
-                np.asarray([meta.row], np.int32),
-                np.asarray([len(members)], np.int32),
-                np.asarray([version], np.int32),
-                np.asarray([init_bal], np.int32),
-                np.asarray([False]))  # NEVER coordinator on restart until
-            self._bal[meta.row] = init_bal  # re-elected (safe default)
-            self._cur[meta.row] = 0
-            self._dec[meta.row] = {}
-            self._ckpt[meta.row] = -1
-            self._la[meta.row] = t0  # pause-eligible when idle
-            self._member_mat[meta.row] = -1
-            self._member_mat[meta.row, :len(members)] = members
-            self._row_gkey[meta.row] = gkey
-            rec = self.logger.get_checkpoint(gkey)
-            if rec is not None and rec.slot >= 0:
-                self.app.restore(name, rec.state)
-                self._cur[meta.row] = rec.slot + 1
-                self._ckpt[meta.row] = rec.slot
+            metas.append(self.table.create(name, members, version))
+        if metas:
+            self._install_rows(metas, self_coord=False, now=t0)
+            # checkpoints fetched ONLY for the rows just rebuilt: a
+            # whole-table read would materialize every state blob —
+            # including paused groups', defeating lazy recovery — and a
+            # pre-existing live group must never be rolled back to a
+            # stale checkpoint from a prior incarnation
+            ck_rows, ck_slots = [], []
+            by_key = {m.gkey: m for m in metas}
+            for rec in self.logger.checkpoints_for(list(by_key)):
+                meta = by_key.get(rec.gkey)
+                if meta is None:
+                    continue
+                self.app.restore(meta.name, rec.state)
+                if rec.slot >= 0:
+                    self._cur[meta.row] = rec.slot + 1
+                    self._ckpt[meta.row] = rec.slot
+                    ck_rows.append(meta.row)
+                    ck_slots.append(rec.slot + 1)
+            if ck_rows:
+                cs = np.asarray(ck_slots, np.int32)
                 self.backend.set_cursor(
-                    np.asarray([meta.row], np.int32),
-                    np.asarray([rec.slot + 1], np.int32),
-                    np.asarray([rec.slot + 1], np.int32))
-            elif rec is not None:
-                self.app.restore(name, rec.state)
+                    np.asarray(ck_rows, np.int32), cs, cs)
         # roll forward the WAL (accepts re-promise; decisions re-execute)
         acc_rows, acc_slots, acc_bals, acc_reqs = [], [], [], []
         dec_by_row: Dict[int, Dict[int, int]] = {}
