@@ -21,8 +21,10 @@ from gigapaxos_tpu.native import KeyRowMap
 from gigapaxos_tpu.paxos.packets import group_key
 
 
-@dataclass
+@dataclass(slots=True)
 class GroupMeta:
+    # slots: at a million groups the per-instance __dict__ (~100B) was
+    # a top line item of the resident bytes/group budget
     name: str
     gkey: int
     row: int
@@ -37,7 +39,12 @@ class GroupTable:
     def __init__(self, capacity: int):
         self.capacity = capacity
         self._by_key: Dict[int, GroupMeta] = {}
-        self._by_row: Dict[int, GroupMeta] = {}
+        # flat row->meta list (8B/slot) instead of a dict (~100B/entry)
+        self._by_row: list = [None] * capacity
+        # interned member tuples: churny workloads create millions of
+        # groups over a handful of distinct member sets — share one
+        # tuple object per distinct set instead of one per group
+        self._msets: Dict[Tuple[int, ...], Tuple[int, ...]] = {}
         # native u64->i32 row index (C++ open addressing when available):
         # rows_for_keys answers a whole packet batch in one call
         self._rows = KeyRowMap(min(capacity, 1 << 16))
@@ -61,7 +68,15 @@ class GroupTable:
         if not self._free:
             raise MemoryError("group capacity exhausted")
         row = self._free.pop()
-        meta = GroupMeta(name, gkey, row, tuple(members), version)
+        mt = tuple(members)
+        if len(self._msets) > 4096:
+            # bound the intern table: rotating memberships could
+            # otherwise accumulate dead sets forever.  Rebuilding from
+            # live groups is O(n) but only fires past 4K distinct sets.
+            self._msets = {m.members: m.members
+                           for m in self._by_key.values()}
+        mt = self._msets.setdefault(mt, mt)
+        meta = GroupMeta(name, gkey, row, mt, version)
         self._by_key[gkey] = meta
         self._by_row[row] = meta
         self._rows.put(gkey, row)
@@ -71,7 +86,7 @@ class GroupTable:
         meta = self._by_key.pop(gkey, None)
         if meta is None:
             return None
-        del self._by_row[meta.row]
+        self._by_row[meta.row] = None
         self._free.append(meta.row)
         self._rows.delete(gkey)
         return meta
@@ -89,7 +104,9 @@ class GroupTable:
         return self._by_key.get(group_key(name))
 
     def by_row(self, row: int) -> Optional[GroupMeta]:
-        return self._by_row.get(row)
+        if 0 <= row < self.capacity:
+            return self._by_row[row]
+        return None
 
     def __iter__(self) -> Iterator[GroupMeta]:
         return iter(self._by_key.values())

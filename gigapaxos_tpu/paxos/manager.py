@@ -447,7 +447,9 @@ class PaxosNode:
         self._member_mat[rows] = -1
         for m in metas:
             self._group_stopped.discard(m.row)  # recycled rows
-            self._dec[m.row] = {}
+            # _dec entries are created lazily on first decision — an
+            # eager empty dict costs 64B x a million idle groups
+            self._dec.pop(m.row, None)
             self._member_mat[m.row, :len(m.members)] = m.members
             self._row_gkey[m.row] = m.gkey
 
@@ -630,7 +632,7 @@ class PaxosNode:
         self._member_mat[meta.row] = -1
         self._member_mat[meta.row, :len(meta.members)] = meta.members
         self._row_gkey[meta.row] = meta.gkey
-        self._dec[meta.row] = {}
+        self._dec.pop(meta.row, None)  # lazily recreated on decisions
         self.app.restore(d["name"], base64.b64decode(d["app"]))
         self.logger.delete_pause(gkey)
         self._paused.discard(gkey)
@@ -1714,7 +1716,10 @@ class PaxosNode:
         if meta is None:
             return
         cur = int(self._cur[row])
-        dec = self._dec[row]
+        dec = self._dec.get(row)
+        if dec is None:
+            dec = {}  # no installed decisions; fall through to the
+            # checkpoint-cut tail with an empty view
         # the busiest per-request Python loop in the system: every dict
         # and attribute hop below runs once per decided request per
         # replica, so the shared tables are bound to locals up front
@@ -2221,7 +2226,7 @@ class PaxosNode:
             for i, (r, s) in enumerate(keys):
                 if res.applied[i] or res.stale[i]:
                     if s >= self._cur[r]:
-                        self._dec[r][s] = dec_by_row[r][s]
+                        self._dec.setdefault(r, {})[s] = dec_by_row[r][s]
             for r in dec_by_row:
                 self._execute_row(r)
         log.info("node %d recovered %d groups in %.3fs", self.id,
