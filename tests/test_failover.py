@@ -93,7 +93,7 @@ def test_failover_under_message_loss(tmp_path, backend):
         nodes[dead].stop()
         # liveness under loss: every request must eventually land —
         # retransmits + parked proposals + periodic election re-drive
-        deadline = time.time() + 60
+        deadline = time.time() + tscale(90)
         done = 0
         k = 0
         while done < 10 and time.time() < deadline:
