@@ -3,8 +3,10 @@
 Reference analog: ``reconfiguration/ReconfigurableAppClientAsync.java`` —
 name create/delete/lookup against reconfigurators plus app requests against
 actives, with an active-replica cache refreshed on misses and retries with
-failover (ref also: ``E2ELatencyAwareRedirector`` — here: stick with the
-last replica that answered).
+failover.  Replica selection (ref: ``E2ELatencyAwareRedirector`` +
+``EchoRequest``): stick with the last replica that answered for a name;
+otherwise try nearest-first by measured RTT (passive EWMA on every rpc,
+seedable with ``probe_latencies()`` ECHO round trips).
 """
 
 from __future__ import annotations
@@ -55,6 +57,11 @@ class ReconfigurableAppClient:
         self._waiting: Dict[int, asyncio.Future] = {}
         self._actives_cache: Dict[str, List[int]] = {}
         self._preferred: Dict[str, int] = {}   # name -> active that answered
+        # measured RTT EWMAs per node (ref: E2ELatencyAwareRedirector
+        # fed by EchoRequest): updated passively on every rpc and on
+        # demand by probe_latencies(); replica failover tries nearest
+        # first
+        self._rtt: Dict[int, float] = {}
         self._rcs = sorted(config.reconfigurators)
 
     # -- plumbing ----------------------------------------------------------
@@ -92,7 +99,7 @@ class ReconfigurableAppClient:
                 if isinstance(obj, pkt.Response):
                     rid = obj.req_id
                 elif isinstance(obj, pkt.Control) and \
-                        obj.body.get("rc") == rc.REPLY:
+                        obj.body.get("rc") in (rc.REPLY, rc.ECHO):
                     rid = obj.body.get("rid")
                 if rid is not None:
                     fut = self._waiting.pop(rid, None)
@@ -108,12 +115,47 @@ class ReconfigurableAppClient:
         _, writer = await self._conn(node)
         fut = asyncio.get_running_loop().create_future()
         self._waiting[rid] = fut
+        t0 = asyncio.get_running_loop().time()
         try:
             writer.write(_LEN.pack(len(frame)) + frame)
             await writer.drain()
-            return await asyncio.wait_for(fut, self.timeout)
+            out = await asyncio.wait_for(fut, self.timeout)
+            # passive RTT EWMA (includes server decide time — the same
+            # end-to-end signal the reference's redirector learns from)
+            dt = asyncio.get_running_loop().time() - t0
+            prev = self._rtt.get(node)
+            self._rtt[node] = dt if prev is None else \
+                prev + 0.2 * (dt - prev)
+            return out
         finally:
             self._waiting.pop(rid, None)
+
+    def _by_latency(self, actives: List[int]) -> List[int]:
+        """Actives ordered nearest-first by measured RTT; unmeasured
+        nodes keep their cache order after the measured ones are tried
+        (they get measured the first time failover reaches them)."""
+        if not self._rtt:
+            return list(actives)
+        inf = float("inf")
+        return sorted(actives, key=lambda a: self._rtt.get(a, inf))
+
+    async def probe_latencies(self) -> Dict[int, float]:
+        """RTT-probe every active with concurrent ECHO round trips
+        (ref: ``EchoRequest`` feeding ``E2ELatencyAwareRedirector``);
+        seeds the latency-aware replica ordering before any app
+        traffic.  Returns actives only (the passive EWMAs also track
+        reconfigurators internally)."""
+        async def one(a: int) -> None:
+            rid = self._rid()
+            try:
+                await self._rpc(a, rid, pkt.Control(
+                    self.id, {"rc": rc.ECHO, "rid": rid}).encode())
+            except (asyncio.TimeoutError, ConnectionError, OSError):
+                self._rtt.pop(a, None)  # unreachable: sorts last
+
+        await asyncio.gather(*(one(a) for a in self.config.actives))
+        return {a: self._rtt[a] for a in self.config.actives
+                if a in self._rtt}
 
     async def _control(self, body: dict) -> dict:
         """Send a control op to a reconfigurator, retrying across them."""
@@ -212,13 +254,22 @@ class ReconfigurableAppClient:
         gkey = pkt.group_key(name)
         req_id = self._rid()
         last: Optional[Exception] = None
+        tried: set = set()
         for attempt in range(self.retries + 1):
             actives = self._actives_cache.get(name)
             if not actives:
                 actives = await self.get_actives(name)
             pref = self._preferred.get(name)
-            dst = pref if (pref in actives and attempt == 0) else \
-                actives[attempt % len(actives)]
+            order = self._by_latency(actives)
+            if pref in actives and attempt == 0:
+                dst = pref
+            else:
+                # nearest untried replica first; a node that just
+                # failed in THIS call is not retried while an untried
+                # one remains
+                dst = next((a for a in order if a not in tried),
+                           order[attempt % len(order)])
+            tried.add(dst)
             try:
                 resp = await self._rpc(
                     dst, req_id,
@@ -247,6 +298,9 @@ class ReconfigurableAppClient:
                 last = RuntimeError(f"status={resp.status} from {dst}")
             except (asyncio.TimeoutError, ConnectionError, OSError) as e:
                 self._preferred.pop(name, None)
+                # a dead node must not keep its stale low RTT and stay
+                # ranked first for every later request
+                self._rtt.pop(dst, None)
                 last = e
         raise TimeoutError(f"request to {name!r} failed: {last}")
 

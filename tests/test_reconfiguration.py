@@ -586,3 +586,32 @@ def test_delete_with_boot_coordinator_down(tmp_path):
         run(delete_phase())
     finally:
         shutdown([nd for nd in nodes if nd not in dead])
+
+
+def test_latency_aware_redirector(tmp_path):
+    """EchoRequest probing + RTT-ordered replica selection (ref:
+    E2ELatencyAwareRedirector): probes measure every active, passive
+    EWMAs track real requests, and the failover order is nearest-first
+    with unmeasured nodes last."""
+    nodes, cfg = make_cluster(tmp_path)
+    try:
+        async def body():
+            cli = ReconfigurableAppClient((1 << 18) + 1, cfg,
+                                          timeout=tscale(20), retries=5)
+            try:
+                rtts = await cli.probe_latencies()
+                assert set(rtts) == set(cfg.actives)
+                assert all(0 < v < tscale(20) for v in rtts.values())
+                # ordering: nearest-first, unmeasured last
+                cli._rtt = {0: 0.005, 1: 0.001}
+                assert cli._by_latency([0, 1, 2]) == [1, 0, 2]
+                # app traffic updates the EWMAs passively
+                cli._rtt.clear()
+                assert await cli.create_names(["lat0"]) == 1
+                await cli.send_request("lat0", b'{"op":"put","k":"a","v":"1"}')
+                assert cli._rtt  # measured something
+            finally:
+                await cli.close()
+        run(body())
+    finally:
+        shutdown(nodes)
