@@ -31,13 +31,17 @@ class PaxosClientAsync:
     """Asyncio client: ``await send_request(name_or_gkey, payload)``."""
 
     def __init__(self, client_id: int, servers: List[Tuple[str, int]],
-                 timeout: float = 5.0, retries: int = 3,
+                 timeout: float = 5.0, retries: Optional[int] = None,
                  retransmit_s: float = 1.0):
         assert 0 < client_id < (1 << 31), \
             "client id must fit the transport's signed-32 handshake"
         self.id = client_id
         self.servers = list(servers)
         self.timeout = timeout  # TOTAL budget per request
+        # None (default): keep retransmitting until the deadline —
+        # liveness across server-side dedupe reaping requires it.  An
+        # int bounds the attempts for fail-fast callers (tools/tests
+        # that want the first non-ok status surfaced quickly).
         self.retries = retries
         # first retransmit after this long (doubling), NOT after the
         # whole timeout — a request stuck behind a dead coordinator must
@@ -100,14 +104,28 @@ class PaxosClientAsync:
         last_exc: Optional[Exception] = None
         deadline = asyncio.get_running_loop().time() + self.timeout
         attempt = 0
-        while attempt <= self.retries:
+        while True:
             remaining = deadline - asyncio.get_running_loop().time()
             if remaining <= 0:
                 break
-            # escalate the retransmit interval; the LAST attempt gets
-            # whatever budget is left
-            wait = remaining if attempt == self.retries else min(
-                self.retransmit_s * (1 << min(attempt, 4)), remaining)
+            if self.retries is not None and attempt > self.retries:
+                break
+            # escalate the retransmit interval up to a CAP and keep
+            # retransmitting until the deadline.  Liveness depends on
+            # it: the server swallows retransmits of an in-flight
+            # proposal (dedupe) and only reaps that entry after ~2
+            # minutes — a client that stops retransmitting (the old
+            # code let the final attempt silently wait the WHOLE
+            # remaining budget) can never get the request re-proposed
+            # after the reap, and stalls for its full timeout
+            # (observed: 1 request stuck 600s while 15 finished in ms).
+            if self.retries is not None and attempt == self.retries:
+                # bounded mode keeps its old contract: the final
+                # attempt may wait out the whole remaining budget
+                wait = remaining
+            else:
+                wait = min(self.retransmit_s * (1 << min(attempt, 4)),
+                           remaining)
             idx = (self._preferred + attempt) % len(self.servers)
             try:
                 _, writer = await self._conn(idx)
@@ -133,8 +151,15 @@ class PaxosClientAsync:
                 # beat so a re-electing group isn't hammered
                 await asyncio.sleep(
                     min(0.05 * (1 << min(attempt, 4)), remaining))
-            except (asyncio.TimeoutError, ConnectionError, OSError) as e:
+            except asyncio.TimeoutError as e:
+                last_exc = e  # the wait itself consumed the interval
+            except (ConnectionError, OSError) as e:
+                # connect refused/reset fails instantly: back off so an
+                # all-servers-down window is not a tight connect spin
+                # pinning the event loop for the whole budget
                 last_exc = e
+                await asyncio.sleep(min(
+                    0.05 * (1 << min(attempt, 4)), remaining))
             finally:
                 self._waiting.pop(req_id, None)
             attempt += 1
@@ -183,7 +208,8 @@ class PaxosClient:
 
     def __init__(self, servers: List[Tuple[str, int]],
                  client_id: Optional[int] = None, timeout: float = 5.0,
-                 retries: int = 3, retransmit_s: float = 1.0):
+                 retries: Optional[int] = None,
+                 retransmit_s: float = 1.0):
         self._loop = asyncio.new_event_loop()
         self._thread = threading.Thread(target=self._loop.run_forever,
                                         daemon=True, name="gp-client")

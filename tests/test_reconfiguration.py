@@ -526,7 +526,10 @@ def test_active_crash_during_creates_epochs_complete(tmp_path):
                 assert await cli.create_names(["acr-post"]) == 1
             finally:
                 await cli.close()
-        deadline = time_mod.time() + tscale(30)
+        # generous: under whole-suite load the revived node's catch-up
+        # competes with neighboring tests for the one core (observed
+        # one miss at tscale(30) in ~10 full-suite runs)
+        deadline = time_mod.time() + tscale(75)
         while True:
             try:
                 run(after_phase())
