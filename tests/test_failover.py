@@ -307,3 +307,44 @@ def test_torture_loss_crash_churn(tmp_path):
         if cli:
             cli.close()
         shutdown([nd for nd in nodes if not nd._stopping])
+
+
+def test_client_retransmits_past_total_loss_window(tmp_path):
+    """Regression for the silent-final-wait client bug: with default
+    (unbounded) retries the client must STILL be retransmitting after
+    the old 4-attempt horizon (~7s) has passed.  The node's OUTBOUND
+    frames are dropped (the request arrives and commits via self-route;
+    every RESPONSE is lost), so only a retransmit sent after the
+    blackout lifts — answered from the response cache — can complete
+    the call.  The old client went silent by then and timed out."""
+    import threading
+
+    nodes, addr_map = make_cluster(tmp_path, n=1, backend="native",
+                                   capacity=1 << 8)
+    node = nodes[0]
+    cli = PaxosClient([addr_map[0]], timeout=tscale(40),
+                      retransmit_s=0.5)
+    try:
+        assert node.create_group("rt", (0,))
+        assert cli.send_request("rt", b"warm").status == 0
+        node.transport.test_drop_rate = 1.0  # drop all outbound replies
+        out = {}
+
+        def go():
+            try:
+                out["resp"] = cli.send_request("rt", b"blackout")
+            except Exception as e:  # noqa: BLE001 - recorded for assert
+                out["err"] = e
+        t = threading.Thread(target=go)
+        t.start()
+        # past the old client's whole retransmit schedule
+        # (0.5+1+2+final-silent-wait): it would now be waiting silently
+        time.sleep(tscale(8))
+        node.transport.test_drop_rate = 0.0
+        t.join(tscale(35))
+        assert not t.is_alive(), "client stuck past its deadline"
+        assert "resp" in out and out["resp"].status == 0, \
+            f"request never answered after loss lifted: {out}"
+    finally:
+        cli.close()
+        shutdown(nodes)
