@@ -343,14 +343,19 @@ def mode_failover(args) -> dict:
                          sync_wal=args.sync_wal, ping_interval_s=0.15,
                          failure_timeout_s=1.0)
     try:
-        pre = emu.run_load(args.requests, concurrency=args.concurrency)
+        # run_load is the per-request asyncio client; at thousands of
+        # IN-FLIGHT requests its per-request timers/retransmits choke
+        # the generator, so failover bounds the depth regardless of the
+        # throughput mode's deeper default
+        conc = min(args.concurrency, 448)
+        pre = emu.run_load(args.requests, concurrency=conc)
         # kill the initial coordinator of group g0's hash majority:
         # every group's initial coordinator is gkey % 5
         victim = group_key(emu.groups[0]) % 5
         time.sleep(0.5)  # let pings establish last_heard
         emu.kill(victim)
         t0 = time.perf_counter()
-        post = emu.run_load(args.requests, concurrency=args.concurrency,
+        post = emu.run_load(args.requests, concurrency=conc,
                             timeout=20.0, client_id=1 << 21)
         t_recover = time.perf_counter() - t0
         return {
@@ -358,6 +363,7 @@ def mode_failover(args) -> dict:
                       f"replicas ({args.backend})",
             "value": post["throughput_rps"], "unit": "req/s",
             "info": {"pre": pre, "post": post, "victim": victim,
+                     "concurrency": conc,
                      "post_wall_s": round(t_recover, 2)},
         }
     finally:

@@ -335,7 +335,7 @@ def test_client_retransmits_past_total_loss_window(tmp_path):
                 out["resp"] = cli.send_request("rt", b"blackout")
             except Exception as e:  # noqa: BLE001 - recorded for assert
                 out["err"] = e
-        t = threading.Thread(target=go)
+        t = threading.Thread(target=go, daemon=True)
         t.start()
         # past the old client's whole retransmit schedule
         # (0.5+1+2+final-silent-wait): it would now be waiting silently
