@@ -218,3 +218,70 @@ async def run_fast_load(servers: Sequence[Tuple[str, int]],
 
 def run_fast_load_sync(*args, **kw) -> Dict:
     return asyncio.run(run_fast_load(*args, **kw))
+
+
+def main(argv=None) -> int:
+    """Standalone load-generator process (ref: ``TESTPaxosClient`` run
+    as its own process against remote ``TESTPaxosServer``s — SURVEY
+    §4.3's across-machines benchmark mode).  Point it at any servers::
+
+        python -m gigapaxos_tpu.testing.loadgen \\
+            --servers hostA:2000,hostB:2000,hostC:2000 \\
+            --groups 1000 --requests 100000 --concurrency 2048
+
+    Groups are addressed by name (``g0..gN-1`` by default — matching
+    ``server.py --paxos-only`` with ``GROUPS=``); prints the same ONE
+    json line as the harness modes."""
+    import argparse
+    import json
+
+    p = argparse.ArgumentParser(prog="gigapaxos_tpu.testing.loadgen")
+    p.add_argument("--servers", required=True,
+                   help="comma-separated host:port list")
+    p.add_argument("--groups", type=int, default=1000,
+                   help="number of groups (names g0..gN-1)")
+    p.add_argument("--group-prefix", default="g")
+    p.add_argument("--requests", type=int, default=100000)
+    p.add_argument("--concurrency", type=int, default=2048)
+    p.add_argument("--payload-bytes", type=int, default=1)
+    p.add_argument("--timeout", type=float, default=60.0)
+    p.add_argument("--client-id", type=int, default=None,
+                   help="base client id (default: derived from pid+time"
+                        " — two CLI runs within the servers' dedup-"
+                        "cache window must NOT reuse ids, or the second"
+                        " run is answered from the response cache "
+                        "without any consensus)")
+    args = p.parse_args(argv)
+
+    import os
+    cid = args.client_id
+    if cid is None:
+        cid = (1 << 20) + (((os.getpid() << 12) ^ int(time.time()))
+                           % ((1 << 30) - (1 << 20)))
+    if not (0 < cid < (1 << 31) - (1 << 22)):
+        p.error(f"--client-id {cid} outside the 31-bit id space")
+
+    servers = []
+    for part in args.servers.split(","):
+        host, colon, port = part.strip().rpartition(":")
+        if not colon or not host or not port.isdigit():
+            p.error(f"--servers entry {part!r} is not host:port")
+        servers.append((host, int(port)))
+    names = [f"{args.group_prefix}{i}" for i in range(args.groups)]
+    stats = run_fast_load_sync(
+        servers, names, args.requests, concurrency=args.concurrency,
+        payload=b"x" * args.payload_bytes, client_id=cid,
+        timeout=args.timeout)
+    print(json.dumps({
+        "metric": f"e2e decided req/s against {len(servers)} servers, "
+                  f"{args.groups} groups, depth {args.concurrency}",
+        "value": stats["throughput_rps"], "unit": "req/s",
+        "info": stats,
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())
