@@ -207,6 +207,14 @@ class PaxosNode:
         # periodic run-for-coordinator re-check in _tick (ref:
         # FailureDetection feeding checkRunForCoordinator periodically).
         self._suspects: Set[int] = set()
+        # row -> quorum execution watermark learned when WE won its
+        # election: until our own cursor reaches it, fresh client
+        # proposals for the row are parked.  A freshly revived
+        # coordinator has EMPTY dedupe tables — proposing a client
+        # retransmit before catching up decides an already-executed
+        # request in a second slot (observed in the torture test:
+        # count 6 of 5 sends).  Cleared by _tick once caught up.
+        self._catchup_barrier: Dict[int, int] = {}
         # row -> [(parked-at, Proposal)]: client traffic that would have
         # been forwarded to a suspect/unknown coordinator while an
         # election is unsettled.  Flushed by _tick or on coordinator
@@ -225,6 +233,14 @@ class PaxosNode:
         self._acc_hi = np.full(cap, -1, np.int64)
         self._acc_ts = np.zeros(cap, np.float64)
         self._batch_t0 = 0.0  # set per worker batch (_process)
+        # Serializes the worker's batch processing against lifecycle
+        # calls arriving on OTHER threads (library/harness
+        # create_groups/delete_groups): the columnar engine swaps
+        # donated device state per call (a concurrent caller can
+        # observe a deleted buffer) and ctypes releases the GIL into
+        # the C engine.  RLock: control packets create/delete groups
+        # from WITHIN worker processing on the same thread.
+        self._engine_lock = threading.RLock()
         # rows whose epoch-stop request has executed: the RSM is closed —
         # later decided slots are skipped and clients told to re-resolve
         # (ref: PaxosInstanceStateMachine stopped/final-state logic)
@@ -383,7 +399,13 @@ class PaxosNode:
         """Batched create (ref: batched CreateServiceName): ONE device
         scatter + ONE durable transaction for n groups — the 10K/s churn
         path.  Returns how many were actually created (existing names
-        skipped)."""
+        skipped).  Thread-safe: serialized against the worker."""
+        with self._engine_lock:
+            return self._create_groups_locked(items, version,
+                                              initial_state, durable)
+
+    def _create_groups_locked(self, items, version, initial_state,
+                              durable) -> int:
         metas = []
         for name, members in items:
             # validate BEFORE any mutation: a failure mid-batch after
@@ -459,7 +481,12 @@ class PaxosNode:
     def delete_groups(self, names: List[str]) -> int:
         """Batched delete: ONE device scatter + ONE durable txn.
         Paused groups delete without hydration (their pause record goes
-        with the birth record)."""
+        with the birth record).  Thread-safe: serialized against the
+        worker."""
+        with self._engine_lock:
+            return self._delete_groups_locked(names)
+
+    def _delete_groups_locked(self, names: List[str]) -> int:
         paused_gone = []
         for n in dict.fromkeys(names):  # dedupe, order-preserving
             gk = pkt.group_key(n)
@@ -489,6 +516,8 @@ class PaxosNode:
         # re-proposable when its retransmit arrives in the successor
         # epoch (same gkey, new instance) — stale entries blackhole it.
         dead_rows = {m.row for m in metas}
+        for row in dead_rows:
+            self._catchup_barrier.pop(row, None)
         for rid in [r for r, fl in self._proposed.items()
                     if fl.row in dead_rows]:
             self._proposed.pop(rid, None)
@@ -528,6 +557,7 @@ class PaxosNode:
         self._member_mat[row] = -1
         self._row_gkey[row] = 0
         self._dec.pop(row, None)
+        self._catchup_barrier.pop(row, None)
 
     def _touch(self, row: int) -> None:
         self._la[row] = time.time()
@@ -827,7 +857,8 @@ class PaxosNode:
             try:
                 first = self._inq.get(timeout=self.batch_timeout)
             except queue_mod.Empty:
-                self._tick()
+                with self._engine_lock:
+                    self._tick()
                 continue
             if first is None:
                 break
@@ -862,13 +893,15 @@ class PaxosNode:
                 c1 = self._ct()
                 DelayProfiler.update_total("w.decode", t0, len(batch),
                                            cpu_t0=c0)
-                self._process(decoded)
+                with self._engine_lock:
+                    self._process(decoded)
                 DelayProfiler.update_total("w.process", t1, len(batch),
                                            cpu_t0=c1)
             except Exception:
                 log.exception("worker batch failed (%d items)", len(batch))
             DelayProfiler.update_delay("node.batch", t0, len(batch))
-            self._tick()
+            with self._engine_lock:
+                self._tick()
 
     def _tick(self) -> None:
         """Periodic duties: failure detection → run-for-coordinator.
@@ -960,6 +993,17 @@ class PaxosNode:
             for row in pend[(self._cur[pend] <= self._acc_hi[pend])
                             & (now - self._acc_ts[pend] > 0.5)]:
                 self._sync_if_gap(int(row))
+        # catch-up barriers: a row whose cursor reached the quorum
+        # watermark opens for fresh proposals (the parked flush below
+        # handles its queue); one still behind pulls decisions again
+        if self._catchup_barrier:
+            for row in list(self._catchup_barrier):
+                if self.table.by_row(row) is None:
+                    del self._catchup_barrier[row]
+                elif int(self._cur[row]) >= self._catchup_barrier[row]:
+                    del self._catchup_barrier[row]
+                else:
+                    self._sync_if_gap(row)
         # re-route proposals parked while leadership was unsettled
         if self._parked:
             for row in list(self._parked):
@@ -969,7 +1013,8 @@ class PaxosNode:
                     continue
                 coord = unpack_ballot(int(self._bal[row]))[1]
                 if row not in self._elections and coord >= 0 and \
-                        coord not in self._suspects:
+                        coord not in self._suspects and \
+                        row not in self._catchup_barrier:
                     self._flush_parked(row)
         if len(self._bounced) > 10000 or \
                 getattr(self, "_last_bounce_gc", 0) + 30 < now:
@@ -1256,6 +1301,11 @@ class PaxosNode:
                     if int(rows[i]) in self._group_stopped:
                         mine[i] = False
                         slow[i] = True
+            if self._catchup_barrier:
+                for i in np.flatnonzero(mine):
+                    if int(rows[i]) in self._catchup_barrier:
+                        mine[i] = False
+                        slow[i] = True
             if slow.any():
                 # unknown group / foreign coordinator / stopped row:
                 # legacy per-object path below handles each such lane
@@ -1271,6 +1321,15 @@ class PaxosNode:
                         self.id, int(sb.gkey[i]), rid, st_, rv))
                     continue
                 if rid in self._proposed:
+                    # in-flight duplicate: swallow the proposal, but
+                    # keep what the retransmit carries — the payload (a
+                    # carryover slot may hold only FLAG_MISSING) and
+                    # the waiter (a carryover-registered rid has none,
+                    # and without it the execute never answers)
+                    self._store_payload(rid, int(sb.flags[i]),
+                                        bytes(sb.payload(i)))
+                    self._client_wait[rid] = (int(snd[i]), now,
+                                              int(sb.gkey[i]))
                     continue
                 self._client_wait[rid] = (int(snd[i]), now,
                                           int(sb.gkey[i]))
@@ -1322,6 +1381,15 @@ class PaxosNode:
                     self._route(coord, prop)
                 continue
             if o.req_id in self._proposed:
+                # swallow the duplicate but keep its payload: a
+                # carryover slot may hold only a FLAG_MISSING
+                # placeholder that this retransmit can fill
+                self._store_payload(o.req_id, o.flags, o.payload)
+                continue
+            if meta.row in self._catchup_barrier:
+                self._park(meta.row, pkt.Proposal(
+                    self.id, o.gkey, o.req_id, o.sender, o.flags,
+                    o.payload))
                 continue
             lanes.append((meta.row, o.req_id, o.flags, o.payload, o.sender))
         for o in props:
@@ -1376,6 +1444,16 @@ class PaxosNode:
                         self._route(coord, o)
                 continue
             if o.req_id in self._proposed:
+                # swallow the duplicate, keep its payload, and record
+                # the entry replica as waiter so the carried slot's
+                # execution answers it (a carryover-registered rid has
+                # no waiter here)
+                self._store_payload(o.req_id, o.flags, o.payload)
+                self._client_wait[o.req_id] = (o.entry, time.time(),
+                                               o.gkey)
+                continue
+            if meta.row in self._catchup_barrier:
+                self._park(meta.row, o)
                 continue
             lanes.append((meta.row, o.req_id, o.flags, o.payload, o.entry))
         if lanes:
@@ -1831,12 +1909,19 @@ class PaxosNode:
         meta = self.table.by_row(row)
         cur = int(self._cur[row])
         coord = unpack_ballot(int(self._bal[row]))[1]
-        dst = coord if (coord >= 0 and coord != self.id) else None
+        dst = coord if (coord >= 0 and coord != self.id
+                        and coord not in self._suspects) else None
         if dst is None:
-            others = [m for m in meta.members if m != self.id]
+            # not the coordinator (dead/ourselves): any live member can
+            # answer — rotate so a deterministic dead pick cannot wedge
+            # the catch-up (a barriered row depends on this completing)
+            others = [m for m in meta.members
+                      if m != self.id and m not in self._suspects]
+            if not others:
+                others = [m for m in meta.members if m != self.id]
             if not others:
                 return
-            dst = others[0]
+            dst = others[int(now * 5) % len(others)]
         self._route(dst, pkt.SyncRequest(self.id, meta.gkey, cur,
                                          cur + self.backend.window))
 
@@ -2118,7 +2203,26 @@ class PaxosNode:
                 if got is not None and not (got[0] & FLAG_MISSING):
                     reprops.append(pkt.Proposal(
                         self.id, meta.gkey, rid, self.id, got[0], got[1]))
-        self._flush_parked(row)
+        # register EVERY carried request as in-flight at its carry slot:
+        # a parked/retransmitted duplicate of a carryover rid must hit
+        # the _proposed dedupe, not be proposed fresh at a second slot —
+        # the same client op deciding in two slots executes twice
+        # (observed in the torture test: a request accepted under the
+        # dying coordinator arrived again via the parked queue and the
+        # flush below re-proposed it beside its own carryover)
+        now_t = time.time()
+        for s, (b, rid, fl_, _pl) in carry.items():
+            if not (fl_ & FLAG_NOOP) and rid not in self._proposed:
+                self._proposed[rid] = _InFlight(
+                    row=row, slot=s, bal=el.bal, proposed=now_t,
+                    redriven=now_t)
+        if cursor > int(self._cur[row]):
+            # the quorum has executed past us: hold fresh proposals
+            # until we catch up (see _catchup_barrier field comment)
+            self._catchup_barrier[row] = cursor
+            self._sync_if_gap(row)
+        else:
+            self._flush_parked(row)
         if reprops:
             self._handle_requests([], reprops)
         # re-propose carryover pvalues at our ballot

@@ -215,6 +215,9 @@ def test_torture_loss_crash_churn(tmp_path):
     + gap sync)."""
     Config.set(PC.PING_INTERVAL_S, 0.15)
     Config.set(PC.FAILURE_TIMEOUT_S, 1.0)
+    # no deactivator: a slow run would pause idle groups mid-test and
+    # the convergence reads would see legitimately-evicted app state
+    Config.set(PC.PAUSE_IDLE_S, 0)
     nodes, addr_map = make_cluster(tmp_path, backend="native")
     cli = None
     try:
