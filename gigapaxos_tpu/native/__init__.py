@@ -176,9 +176,13 @@ def _p(a: np.ndarray, ctype=None):
     try:
         return _C0.from_buffer(a)
     except (TypeError, ValueError, BufferError):
-        # read-only / non-contiguous: data_as keeps a reference to the
-        # array on the returned object (a bare .ctypes.data int would
-        # let a temporary be freed before the C call reads it)
+        # read-only: data_as keeps a reference to the array on the
+        # returned object (a bare .ctypes.data int would let a temporary
+        # be freed before the C call reads it).  A strided view must
+        # fail loudly here — the C side assumes contiguous layout and
+        # would silently read mis-laid-out memory.
+        if not a.flags.c_contiguous:
+            raise ValueError("native call requires a C-contiguous array")
         return a.ctypes.data_as(ctypes.c_void_p)
 
 

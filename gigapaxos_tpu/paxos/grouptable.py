@@ -45,6 +45,7 @@ class GroupTable:
         # groups over a handful of distinct member sets — share one
         # tuple object per distinct set instead of one per group
         self._msets: Dict[Tuple[int, ...], Tuple[int, ...]] = {}
+        self._msets_rebuild_at = 4096
         # native u64->i32 row index (C++ open addressing when available):
         # rows_for_keys answers a whole packet batch in one call
         self._rows = KeyRowMap(min(capacity, 1 << 16))
@@ -69,12 +70,16 @@ class GroupTable:
             raise MemoryError("group capacity exhausted")
         row = self._free.pop()
         mt = tuple(members)
-        if len(self._msets) > 4096:
+        if len(self._msets) > self._msets_rebuild_at:
             # bound the intern table: rotating memberships could
             # otherwise accumulate dead sets forever.  Rebuilding from
-            # live groups is O(n) but only fires past 4K distinct sets.
+            # live groups is O(n), so the threshold doubles whenever a
+            # rebuild fails to shrink below it — with >4K *live* distinct
+            # sets a fixed bound would rebuild on every create, an
+            # O(live-groups) dict build per create.
             self._msets = {m.members: m.members
                            for m in self._by_key.values()}
+            self._msets_rebuild_at = max(4096, 2 * len(self._msets))
         mt = self._msets.setdefault(mt, mt)
         meta = GroupMeta(name, gkey, row, mt, version)
         self._by_key[gkey] = meta

@@ -294,6 +294,21 @@ def mode_scale(args) -> dict:
     from gigapaxos_tpu.paxos.manager import PaxosNode
     from gigapaxos_tpu.testing.harness import free_ports
 
+    def _rss_kb() -> float:
+        # CURRENT resident set, not ru_maxrss: the high-water mark can
+        # already sit above the post-create footprint after JAX/backend
+        # warmup, which would make the delta read ~0 and bytes_per_group
+        # meaningless.  /proc is Linux-only; fall back to the high-water
+        # mark elsewhere.
+        try:
+            with open("/proc/self/statm") as f:
+                pages = int(f.read().split()[1])
+            import os as os_mod
+            return pages * os_mod.sysconf("SC_PAGE_SIZE") / 1024
+        except (OSError, IndexError, ValueError):
+            kb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+            return kb / (1024 if sys_mod.platform == "darwin" else 1)
+
     n = max(1, args.requests)
     addr = {0: ("127.0.0.1", free_ports(1)[0])}
     node = PaxosNode(0, addr, NoopApp(), args.logdir,
@@ -302,7 +317,7 @@ def mode_scale(args) -> dict:
                      window=args.window)
     node.start()
     try:
-        rss0 = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+        rss0 = _rss_kb()
         t0 = time.perf_counter()
         made = 0
         batch = 16384
@@ -310,13 +325,11 @@ def mode_scale(args) -> dict:
             made += node.create_groups(
                 [(f"m{i}", (0,)) for i in range(at, min(at + batch, n))])
         wall = time.perf_counter() - t0
-        rss1 = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+        rss1 = _rss_kb()
         assert made == n, (
             f"only {made}/{n} created — reused --logdir with existing "
             "groups? scale mode needs a fresh log directory")
-        # ru_maxrss is KB on Linux, bytes on macOS
-        rss_kb = (rss1 - rss0) / (1024 if sys_mod.platform == "darwin"
-                                  else 1)
+        rss_kb = rss1 - rss0
         cli = PaxosClient([addr[0]], timeout=60)
         try:
             status = cli.send_request(f"m{n - 1}", b"ping").status

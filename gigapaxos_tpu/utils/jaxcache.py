@@ -1,0 +1,41 @@
+"""Persistent XLA compilation cache, shared by every entry point.
+
+One-core operational reality: SPMD specializations of the columnar
+kernels take seconds each to compile, and the driver's dryrun, the
+bench, and the test suite all re-compile the same dozen kernels from
+scratch in fresh processes.  JAX's persistent compilation cache
+(``jax_compilation_cache_dir``) keys on (HLO, platform, flags), so a
+repo-local cache directory makes every process after the first hit
+warm compiles — which is the difference between a dryrun that fits the
+driver's budget and one that times out (round-3 ``MULTICHIP_r03.json``
+``rc=124``).
+
+The cache dir lives inside the repo (untracked) so it survives across
+driver rounds on the same machine but never ships in the tree.
+"""
+
+from __future__ import annotations
+
+import os
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+CACHE_DIR = os.path.join(_REPO_ROOT, ".jax_cache")
+
+
+def enable_persistent_cache(dirpath: str | None = None) -> bool:
+    """Point jax at the repo-local compilation cache.  Best-effort: a
+    jax build without the knobs (or an unwritable dir) degrades to
+    normal in-memory caching."""
+    import jax
+    try:
+        jax.config.update("jax_compilation_cache_dir",
+                          dirpath or CACHE_DIR)
+        # cache everything: the hot kernels are small programs whose
+        # compile time (not size) is what hurts on this host
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          0.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        return True
+    except Exception:
+        return False

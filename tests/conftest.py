@@ -12,11 +12,19 @@ _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8").strip()
+# silence XLA's AOT-cache-load feature-mismatch warnings (pseudo-features
+# like +prefer-no-scatter; harmless but one per cache hit) — must be set
+# before the XLA extension loads
+os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "3")
 
 import jax  # noqa: E402
 
 if not os.environ.get("GP_TEST_TPU"):
     jax.config.update("jax_platforms", "cpu")
+
+from gigapaxos_tpu.utils.jaxcache import enable_persistent_cache  # noqa: E402
+
+enable_persistent_cache()
 
 import pytest  # noqa: E402
 
