@@ -392,7 +392,6 @@ def main(argv=None) -> int:
     # storm benchmark is bench.py, not this harness.
     import jax
 
-    jax.config.update("jax_platforms", "cpu")
     p = argparse.ArgumentParser(prog="gigapaxos_tpu.testing.main")
     p.add_argument("mode",
                    choices=["throughput", "churn", "failover", "scale"])
@@ -416,8 +415,22 @@ def main(argv=None) -> int:
     p.add_argument("--via-reconfigurator", action="store_true",
                    help="churn mode: drive creates/deletes through the "
                         "reconfiguration control plane (epoch FSM)")
+    p.add_argument("--on-device", action="store_true",
+                   help="columnar backend: keep group state resident on "
+                        "the real accelerator (PC.COLUMNAR_DEVICE="
+                        "default) instead of the host-XLA pin — the "
+                        "SURVEY §7.2 phase-5 'flip backend to TPU' for "
+                        "the SERVED path.  Run under an external "
+                        "watchdog: a wedged accelerator hangs backend "
+                        "init (this host's tunnel does so for hours).")
     p.add_argument("--logdir", default=None)
     args = p.parse_args(argv)
+    if args.on_device:
+        from gigapaxos_tpu.paxos.paxosconfig import PC
+        from gigapaxos_tpu.utils.config import Config
+        Config.set(PC.COLUMNAR_DEVICE, "default")
+    else:
+        jax.config.update("jax_platforms", "cpu")
     if args.logdir is None:
         args.logdir = tempfile.mkdtemp(prefix="gp_bench_")
     out = {"throughput": mode_throughput, "churn": mode_churn,
