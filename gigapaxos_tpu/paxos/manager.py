@@ -38,8 +38,8 @@ import numpy as np
 
 from gigapaxos_tpu import native
 from gigapaxos_tpu.net.transport import Transport
-from gigapaxos_tpu.ops.types import (NODE_MASK, NO_BALLOT, NO_SLOT,
-                                     pack_ballot, unpack_ballot)
+from gigapaxos_tpu.ops.types import (NODE_BITS, NODE_MASK, NO_BALLOT,
+                                     NO_SLOT, pack_ballot, unpack_ballot)
 from gigapaxos_tpu.paxos import packets as pkt
 from gigapaxos_tpu.paxos.backend import (AcceptorBackend, ColumnarBackend,
                                          NativeBackend, ScalarBackend)
@@ -61,6 +61,8 @@ FLAG_NOOP = 2
 # only): receivers keep their own copy if they have one; executors treat a
 # still-missing payload as a gap and sync — never fabricate an empty one
 FLAG_MISSING = 4
+
+_UNSET = object()  # cache-miss sentinel (None is a valid cached value)
 
 
 def _no_cpu_clock():
@@ -323,6 +325,7 @@ class PaxosNode:
         self.n_parked = 0         # proposals parked awaiting leadership
         self.n_park_dropped = 0   # parked proposals dropped at cap
         self.n_redrive_capped = 0  # re-drive ticks that hit the 256 cap
+        self.n_installs = 0       # coordinator installs won (failover)
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -921,6 +924,28 @@ class PaxosNode:
                 fn()
             except Exception:
                 log.exception("tick hook %r failed", fn)
+        # self-stall guard: if WE went dark longer than the failure
+        # timeout (mass create holding the engine lock, GC, a compile
+        # storm), the missing pings are OUR silence, not the peers' —
+        # declaring deaths now starts a spurious mass election (observed:
+        # a 100K-group create made every node suspect every other and a
+        # rogue coordinator took over the whole fleet).  Give peers a
+        # fresh window instead.
+        prev_tick = getattr(self, "_last_tick_wall", now)
+        self._last_tick_wall = now
+        if now - prev_tick > self.failure_timeout:
+            # bounded: under CHRONIC load (every tick gap > timeout, e.g.
+            # a successor grinding through a 1M-group takeover) the guard
+            # must not suppress detection forever — live peers refresh
+            # _last_heard out-of-band as their frames are processed, so
+            # after a few guarded ticks real deaths still age out
+            self._stall_streak = getattr(self, "_stall_streak", 0) + 1
+            if self._stall_streak <= 3:
+                for k in self._last_heard:
+                    self._last_heard[k] = now
+                return
+        else:
+            self._stall_streak = 0
         dead = [n for n, t in self._last_heard.items()
                 if now - t > self.failure_timeout]
         for n in dead:
@@ -932,20 +957,26 @@ class PaxosNode:
         # rescan for rows still led by it (covers elections that never
         # started: we weren't next in line, or the next-in-line died too)
         if self._elections:
+            stalled: List[int] = []
             for row, el in list(self._elections.items()):
                 if now - el.started >= 2.0:
-                    meta = self.table.by_row(row)
-                    if meta is None:
+                    if self.table.by_row(row) is None:
                         self._elections.pop(row, None)
                     else:
-                        self._start_election(row, meta)
+                        stalled.append(row)
+            if len(stalled) >= 64:
+                # mass takeover re-drive: one PrepareBatch wave, not one
+                # Prepare frame per (row, member)
+                self._start_elections_batch(stalled, now)
+            else:
+                for row in stalled:
+                    self._start_election(row, self.table.by_row(row))
         if self._suspects:
-            for meta in list(self.table):
-                if meta.row in self._elections:
-                    continue
-                coord = unpack_ballot(int(self._bal[meta.row]))[1]
-                if coord in self._suspects:
-                    self._run_if_next_in_line(meta, coord, now)
+            # vectorized rescan (was a Python loop over every meta per
+            # tick — minutes at 1M groups); rows with an election fresher
+            # than the re-drive backoff are skipped inside
+            for s in list(self._suspects):
+                self._elect_rows_led_by(s, now)
         # accept re-drive (ref: the coordinator's accept retransmitter):
         # an in-flight proposal whose decision hasn't landed within ~1s
         # is re-emitted to every member — a lost Accept otherwise stalls
@@ -1139,8 +1170,22 @@ class PaxosNode:
         prepares = by_type.pop(pkt.Prepare, [])
         if prepares:
             self._handle_prepares(prepares)
+        pbs = by_type.pop(pkt.PrepareBatch, [])
+        if pbs:
+            t0 = time.monotonic()
+            self._handle_prepare_batches(pbs)
+            DelayProfiler.update_total(
+                "w.prepare_batch", t0, sum(len(p.gkey) for p in pbs))
         for o in by_type.pop(pkt.PrepareReply, []):
             self._handle_prepare_reply(o)
+        prbs = by_type.pop(pkt.PrepareReplyBatch, [])
+        if prbs:
+            t0 = time.monotonic()
+            for o in prbs:
+                self._handle_prepare_reply_batch(o)
+            DelayProfiler.update_total(
+                "w.prepare_reply_batch", t0,
+                sum(len(p.gkey) for p in prbs))
 
         # hot path, pipeline order
         reqs = by_type.pop(pkt.Request, [])
@@ -1206,6 +1251,7 @@ class PaxosNode:
                 f"redrive={self.n_redriven}"
                 f"(capped={self.n_redrive_capped}) "
                 f"park={self.n_parked}(drop={self.n_park_dropped}) "
+                f"installs={self.n_installs} "
                 f"groups={len(self.table)} "
                 f"net[{self.transport.stats()}]")
 
@@ -2043,31 +2089,96 @@ class PaxosNode:
         self._last_heard.pop(node, None)
         self._suspects.add(node)
         log.info("node %d: peer %d suspected dead", self.id, node)
-        now = time.time()
-        for meta in list(self.table):
-            self._run_if_next_in_line(meta, node, now)
+        self._elect_rows_led_by(node, time.time())
 
-    def _run_if_next_in_line(self, meta, dead: int, now: float) -> None:
-        """If this row's believed coordinator is ``dead`` and self is the
-        first live member after it in ring order, run phase 1 (ref:
-        deterministic next-in-line from ballot/coordinator order)."""
-        row = meta.row
-        bal = int(self._bal[row])
-        _num, coord = unpack_ballot(bal)
-        if coord != dead or self.id not in meta.members:
+    def _elect_rows_led_by(self, dead: int, now: float) -> None:
+        """Vectorized replacement for the per-meta scan (SURVEY §3.5:
+        mass failover must be a batched pass, not a Python loop over a
+        million groups): one numpy compare over the packed-ballot mirror
+        finds every row led by ``dead``; the next-in-line decision is
+        computed once per DISTINCT member set (interned tuples — a
+        million-group fleet typically has a handful)."""
+        cand = np.flatnonzero((self._bal >= 0)
+                              & ((self._bal & NODE_MASK) == dead))
+        if not len(cand):
             return
-        order = list(meta.members)
-        start = (order.index(coord) + 1) % len(order)
-        nxt = None
+        by_row = self.table._by_row
+        nxt_cache: Dict[Tuple[int, ...], Optional[int]] = {}
+        elect: List[int] = []
+        for row in cand.tolist():
+            meta = by_row[row]
+            if meta is None or self.id not in meta.members:
+                continue
+            el = self._elections.get(row)
+            if el is not None and now - el.started < 2.0:
+                continue
+            mems = meta.members
+            nxt = nxt_cache.get(mems, _UNSET)
+            if nxt is _UNSET:
+                nxt = self._next_in_line(mems, dead, now)
+                nxt_cache[mems] = nxt
+            if nxt == self.id:
+                elect.append(row)
+        if not elect:
+            return
+        if len(elect) < 64:
+            for row in elect:
+                self._start_election(row, by_row[row])
+        else:
+            self._start_elections_batch(elect, now)
+
+    def _next_in_line(self, members: Tuple[int, ...], dead: int,
+                      now: float) -> Optional[int]:
+        """First live member after ``dead`` in ring order (ref:
+        deterministic next-in-line from ballot/coordinator order)."""
+        if dead not in members:
+            return None
+        order = list(members)
+        start = (order.index(dead) + 1) % len(order)
         for k in range(len(order)):
             cand = order[(start + k) % len(order)]
             if cand == dead:
                 continue
             if cand == self.id or now - self._last_heard.get(
                     cand, 0) <= self.failure_timeout:
-                nxt = cand
-                break
-        if nxt == self.id:
+                return cand
+        return None
+
+    def _start_elections_batch(self, rows: List[int], now: float) -> None:
+        """Batched phase-1 kickoff: one ``PrepareBatch`` frame per member
+        per 64K rows instead of one Prepare frame per (row, member)."""
+        arr = np.asarray(rows, np.int64)
+        bals = self._bal[arr].astype(np.int64)
+        nums = np.where(bals >= 0, bals >> NODE_BITS, 0)
+        new_bals = ((nums + 1) << NODE_BITS | self.id).astype(np.int32)
+        gkeys = self._row_gkey[arr]
+        by_row = self.table._by_row
+        by_mems: Dict[Tuple[int, ...], List[int]] = {}
+        for i, row in enumerate(arr.tolist()):
+            self._elections[row] = _Election(bal=int(new_bals[i]),
+                                             started=now)
+            by_mems.setdefault(by_row[row].members, []).append(i)
+        CH = 1 << 16
+        for mems, idxs in by_mems.items():
+            idx = np.asarray(idxs, np.int64)
+            for at in range(0, len(idx), CH):
+                part = idx[at:at + CH]
+                fg = np.ascontiguousarray(gkeys[part])
+                fb = np.ascontiguousarray(new_bals[part])
+                for m in mems:
+                    self._route(m, pkt.PrepareBatch(self.id, fg, fb))
+        log.info("node %d: batch election for %d groups", self.id,
+                 len(rows))
+
+    def _run_if_next_in_line(self, meta, dead: int, now: float) -> None:
+        """If this row's believed coordinator is ``dead`` and self is the
+        first live member after it in ring order, run phase 1 (single-row
+        path; the mass path is ``_elect_rows_led_by``)."""
+        row = meta.row
+        _num, coord = unpack_ballot(int(self._bal[row]))
+        if coord != dead or self.id not in meta.members:
+            return
+        if self._next_in_line(meta.members, dead, now) == self.id:
             self._start_election(row, meta)
 
     def _start_election(self, row: int, meta) -> None:
@@ -2117,6 +2228,148 @@ class PaxosNode:
                 int(res.exec_cursor[i]), slots,
                 res.win_bal[i][:m], res.win_req_lo[i][:m],
                 res.win_req_hi[i][:m], pls))
+
+    def _handle_prepare_batches(self, objs: List) -> None:
+        """Mass-failover phase 1 at an acceptor: ONE backend.prepare call
+        per frame (the batched [G, W] gather of SURVEY §3.5) and ONE
+        PrepareReplyBatch back.  Windows are flattened ragged — idle
+        groups (the mass-takeover common case) contribute zero entries."""
+        for o in objs:
+            gkeys = np.ascontiguousarray(o.gkey)
+            rows = self._rows_for_keys(gkeys).astype(np.int64)
+            ok = rows >= 0
+            if not ok.any():
+                continue
+            rows_ok = rows[ok]
+            bals_ok = np.ascontiguousarray(o.bal[ok], np.int32)
+            res = self.backend.prepare(rows_ok.astype(np.int32), bals_ok)
+            np.maximum.at(self._bal, rows_ok, np.asarray(res.cur_bal))
+            live = np.asarray(res.win_slot) >= 0  # compacted-left (SPI)
+            counts = live.sum(axis=1).astype(np.int32)
+            total = int(counts.sum())
+            if total:
+                flat = np.flatnonzero(live.reshape(-1))
+                slots_f = np.asarray(res.win_slot).reshape(-1)[flat]
+                wbals_f = np.asarray(res.win_bal).reshape(-1)[flat]
+                rlo_f = np.asarray(res.win_req_lo).reshape(-1)[flat]
+                rhi_f = np.asarray(res.win_req_hi).reshape(-1)[flat]
+                pls = []
+                for j in range(total):
+                    req = _join_req(int(rlo_f[j]), int(rhi_f[j]))
+                    got = self._payload_get(req)
+                    fl, pl = got if got is not None else (FLAG_MISSING,
+                                                         b"")
+                    pls.append(bytes([fl]) + pl)
+            else:
+                slots_f = wbals_f = rlo_f = rhi_f = np.zeros(0, np.int32)
+                pls = []
+            acked = np.asarray(res.acked)
+            self._route(o.sender, pkt.PrepareReplyBatch(
+                self.id, np.ascontiguousarray(gkeys[ok]),
+                np.where(acked, bals_ok,
+                         np.asarray(res.cur_bal)).astype(np.int32),
+                acked.astype(np.uint8),
+                np.asarray(res.exec_cursor, np.int32), counts,
+                slots_f.astype(np.int32), wbals_f.astype(np.int32),
+                rlo_f.astype(np.int32), rhi_f.astype(np.int32), pls))
+
+    def _handle_prepare_reply_batch(self, o) -> None:
+        """Counterpart at the would-be coordinator.  The empty-window
+        acked rows (idle fleet) take a vectorized fast path straight to
+        ONE batched install; windowed/nacked rows reuse the per-row
+        merge machinery."""
+        gkeys = np.ascontiguousarray(o.gkey)
+        rows = self.table.rows_for_keys(gkeys).astype(np.int64)
+        counts = np.asarray(o.counts)
+        offs = np.concatenate([[0], np.cumsum(counts)]).astype(np.int64)
+        install_rows: List[int] = []
+        by_row = self.table._by_row
+        for i in range(len(rows)):
+            row = int(rows[i])
+            meta = by_row[row] if row >= 0 else None
+            if meta is None:
+                continue
+            el = self._elections.get(row)
+            if el is None:
+                continue
+            bal = int(o.bal[i])
+            if not o.acked[i]:
+                if bal > el.bal:
+                    if bal > self._bal[row]:
+                        self._bal[row] = bal
+                    del self._elections[row]
+                continue
+            if bal != el.bal:
+                continue
+            el.acks.add(o.sender)
+            el.cursor = max(el.cursor, int(o.cursor[i]))
+            for j in range(int(offs[i]), int(offs[i + 1])):
+                s = int(o.slots[j])
+                b = int(o.wbals[j])
+                req = _join_req(int(o.req_lo[j]), int(o.req_hi[j]))
+                blob = o.payloads[j] if j < len(o.payloads) else b""
+                fl, pl = (blob[0], bytes(blob[1:])) if blob \
+                    else (FLAG_MISSING, b"")
+                prev = el.merged.get(s)
+                if prev is None or b > prev[0] or (
+                        b == prev[0] and (prev[2] & FLAG_MISSING)
+                        and not (fl & FLAG_MISSING)):
+                    el.merged[s] = (b, req, fl, pl)
+            if len(el.acks) >= len(meta.members) // 2 + 1:
+                install_rows.append(row)
+        if not install_rows:
+            return
+        # split: rows with carryover state or a catch-up need go through
+        # the full per-row install; idle rows (no merged pvalues, cursor
+        # already reached) install in ONE batched backend call
+        simple: List[int] = []
+        for row in install_rows:
+            el = self._elections[row]
+            if el.merged or el.cursor > int(self._cur[row]):
+                self._install_as_coordinator(row, by_row[row],
+                                             self._elections.pop(row))
+            else:
+                simple.append(row)
+        if simple:
+            self._install_simple_batch(simple)
+
+    def _install_simple_batch(self, rows: List[int]) -> None:
+        """Batched coordinator install for idle rows: empty carryover,
+        cursor caught up — the mass-takeover common case."""
+        n = len(rows)
+        W = self.backend.window
+        arr = np.asarray(rows, np.int64)
+        els = [self._elections.pop(r) for r in rows]
+        bals = np.asarray([el.bal for el in els], np.int32)
+        next_slots = self._cur[arr].astype(np.int32)
+        self.backend.install_coordinator(
+            arr.astype(np.int32), bals, next_slots,
+            np.full((n, W), NO_SLOT, np.int32), np.zeros((n, W),
+                                                         np.uint64))
+        self._bal[arr] = bals
+        self.n_installs += n
+        # reconcile in-flight proposals: with an empty quorum view every
+        # one of ours for these rows is an orphan — re-propose fresh
+        # under the new regime (invert ONCE, not a _proposed scan per row)
+        reprops: List = []
+        if self._proposed:
+            rowset = set(rows)
+            for rid, fl in [(r, f) for r, f in self._proposed.items()
+                            if f.row in rowset]:
+                self._proposed.pop(rid, None)
+                got = self._payload_get(rid)
+                if got is not None and not (got[0] & FLAG_MISSING):
+                    meta = self.table.by_row(fl.row)
+                    if meta is not None:
+                        reprops.append(pkt.Proposal(
+                            self.id, meta.gkey, rid, self.id, got[0],
+                            got[1]))
+        for row in rows:
+            self._flush_parked(row)
+        if reprops:
+            self._handle_requests([], reprops)
+        log.info("node %d: batch-installed coordinator for %d groups",
+                 self.id, n)
 
     def _handle_prepare_reply(self, o) -> None:
         meta = self.table.by_key(o.gkey)
@@ -2183,6 +2436,7 @@ class PaxosNode:
             np.asarray([row], np.int32), np.asarray([el.bal], np.int32),
             np.asarray([next_slot], np.int32), cs, cr)
         self._bal[row] = el.bal
+        self.n_installs += 1
         log.info("node %d now coordinator of %s at bal %d (carry %d)",
                  self.id, meta.name, el.bal, len(carry))
         # reconcile OUR in-flight proposals with the new regime: entries

@@ -71,6 +71,8 @@ class PacketType(IntEnum):
     CHECKPOINT_REPLY = 16
     CONTROL = 17          # JSON control-plane envelope (reconfiguration)
     CHUNK = 18            # large-frame chunking (LargeCheckpointer analog)
+    PREPARE_BATCH = 19    # mass failover: n phase-1s in one frame
+    PREPARE_REPLY_BATCH = 20
 
 
 _HDR = struct.Struct("<BII")  # type, sender (u32, matches the transport's
@@ -209,6 +211,98 @@ class CommitBatch:
         rlo = np.frombuffer(body[o:o + 4 * n], np.int32); o += 4 * n
         rhi = np.frombuffer(body[o:o + 4 * n], np.int32); o += 4 * n
         return cls(sender, gkey, slot, bal, rlo, rhi)
+
+
+@dataclass
+class PrepareBatch:
+    """Would-be coordinator → replicas: n phase-1s in ONE frame.
+
+    Ref: the reference has no batched prepare — a coordinator death
+    walks every led group and emits one PreparePacket each (SURVEY §3.5
+    notes the columnar rebuild should make mass failover "a batched
+    gather over [G, W]").  At 100K+ groups per dead coordinator,
+    per-group frames are minutes of host loops; this is the wire form
+    that lets the whole takeover ride the same SoA path as accepts.
+    """
+
+    sender: int
+    gkey: np.ndarray   # u64[n]
+    bal: np.ndarray    # i32[n] packed ballot (one per group: each row's
+    #                    ballot number advances independently)
+
+    TYPE = PacketType.PREPARE_BATCH
+
+    def encode(self) -> bytes:
+        n = len(self.gkey)
+        return (_HDR.pack(self.TYPE, self.sender, n) +
+                np.ascontiguousarray(self.gkey, np.uint64).tobytes() +
+                np.ascontiguousarray(self.bal, np.int32).tobytes())
+
+    @classmethod
+    def decode(cls, sender, n, body) -> "PrepareBatch":
+        o = 0
+        gkey = np.frombuffer(body[o:o + 8 * n], np.uint64); o += 8 * n
+        bal = np.frombuffer(body[o:o + 4 * n], np.int32)
+        return cls(sender, gkey, bal)
+
+
+@dataclass
+class PrepareReplyBatch:
+    """Replica → would-be coordinator: n phase-1 replies in ONE frame.
+
+    The accepted windows are RAGGED (most groups in a mass takeover are
+    idle → zero live pvalues), so they ride as a counts array plus
+    flattened SoA columns — the idle-fleet common case costs 0 bytes of
+    window per group.
+    """
+
+    sender: int
+    gkey: np.ndarray     # u64[n]
+    bal: np.ndarray      # i32[n]: the prepare's bal (ack) or promised
+    acked: np.ndarray    # u8[n]
+    cursor: np.ndarray   # i32[n] exec cursor
+    counts: np.ndarray   # i32[n] live window entries per row
+    slots: np.ndarray    # i32[sum(counts)] flattened
+    wbals: np.ndarray    # i32[sum]
+    req_lo: np.ndarray   # i32[sum]
+    req_hi: np.ndarray   # i32[sum]
+    payloads: List[bytes] = field(default_factory=list)  # len sum
+
+    TYPE = PacketType.PREPARE_REPLY_BATCH
+    _S = struct.Struct("<I")  # total window entries
+
+    def encode(self) -> bytes:
+        n = len(self.gkey)
+        m = len(self.slots)
+        return (_HDR.pack(self.TYPE, self.sender, n) +
+                self._S.pack(m) +
+                np.ascontiguousarray(self.gkey, np.uint64).tobytes() +
+                np.ascontiguousarray(self.bal, np.int32).tobytes() +
+                np.ascontiguousarray(self.acked, np.uint8).tobytes() +
+                np.ascontiguousarray(self.cursor, np.int32).tobytes() +
+                np.ascontiguousarray(self.counts, np.int32).tobytes() +
+                np.ascontiguousarray(self.slots, np.int32).tobytes() +
+                np.ascontiguousarray(self.wbals, np.int32).tobytes() +
+                np.ascontiguousarray(self.req_lo, np.int32).tobytes() +
+                np.ascontiguousarray(self.req_hi, np.int32).tobytes() +
+                _pack_blobs(self.payloads or [b""] * m))
+
+    @classmethod
+    def decode(cls, sender, n, body) -> "PrepareReplyBatch":
+        (m,) = cls._S.unpack_from(body, 0)
+        o = cls._S.size
+        gkey = np.frombuffer(body[o:o + 8 * n], np.uint64); o += 8 * n
+        bal = np.frombuffer(body[o:o + 4 * n], np.int32); o += 4 * n
+        acked = np.frombuffer(body[o:o + n], np.uint8); o += n
+        cursor = np.frombuffer(body[o:o + 4 * n], np.int32); o += 4 * n
+        counts = np.frombuffer(body[o:o + 4 * n], np.int32); o += 4 * n
+        slots = np.frombuffer(body[o:o + 4 * m], np.int32); o += 4 * m
+        wbals = np.frombuffer(body[o:o + 4 * m], np.int32); o += 4 * m
+        rlo = np.frombuffer(body[o:o + 4 * m], np.int32); o += 4 * m
+        rhi = np.frombuffer(body[o:o + 4 * m], np.int32); o += 4 * m
+        blobs, _ = _unpack_blobs(body[o:], m)
+        return cls(sender, gkey, bal, acked, cursor, counts, slots,
+                   wbals, rlo, rhi, blobs)
 
 
 # --------------------------------------------------------------------------
@@ -646,6 +740,8 @@ _DECODERS = {
     PacketType.CHECKPOINT_REPLY: CheckpointReply,
     PacketType.CONTROL: Control,
     PacketType.CHUNK: Chunk,
+    PacketType.PREPARE_BATCH: PrepareBatch,
+    PacketType.PREPARE_REPLY_BATCH: PrepareReplyBatch,
 }
 
 

@@ -85,15 +85,31 @@ class PaxosEmulation:
         return tuple(sorted((start + j) % self.n_nodes
                             for j in range(self.group_size)))
 
-    def create_groups(self, n: int, prefix: str = "g") -> List[str]:
-        names = [f"{prefix}{i}" for i in range(n)]
+    def create_groups(self, n: int, prefix: str = "g",
+                      names: Optional[List[str]] = None) -> List[str]:
+        if names is None:
+            names = [f"{prefix}{i}" for i in range(n)]
         per_node: Dict[int, List] = {}
         for name in names:
             mem = self.members_of(name)
             for m in mem:
                 per_node.setdefault(m, []).append((name, mem))
-        for m, items in per_node.items():
-            self.nodes[m].create_groups(items)
+        # chunked + interleaved across nodes: one giant create_groups
+        # call holds a node's engine lock for the whole batch, starving
+        # its worker (and at 100K+ groups, starving ping processing past
+        # the failure timeout — see the manager's self-stall guard)
+        CH = 16384
+        at = 0
+        while True:
+            any_left = False
+            for m, items in per_node.items():
+                part = items[at:at + CH]
+                if part:
+                    any_left = True
+                    self.nodes[m].create_groups(part)
+            if not any_left:
+                break
+            at += CH
         self.groups.extend(names)
         return names
 

@@ -1,0 +1,101 @@
+"""Mass coordinator takeover via the batched prepare path (SURVEY §3.5:
+prepare/prepare-reply as a batched pass, not per-group frames).
+
+Covers the PrepareBatch/PrepareReplyBatch SoA codecs and the end-to-end
+storm: one node coordinates EVERY group, dies, and the next-in-line must
+take all of them over through `_elect_rows_led_by` →
+`_start_elections_batch` → `_handle_prepare_batches` →
+`_handle_prepare_reply_batch` → `_install_simple_batch` (the ≥64-row
+batch path, not the scalar per-row election machinery).
+"""
+
+import time
+
+import numpy as np
+
+from gigapaxos_tpu.paxos import packets as pkt
+from gigapaxos_tpu.paxos.packets import group_key
+from gigapaxos_tpu.testing.harness import PaxosEmulation
+
+from tests.conftest import tscale
+
+
+def test_prepare_batch_codec_roundtrip():
+    o = pkt.PrepareBatch(
+        3, np.arange(5, dtype=np.uint64) + (1 << 60),
+        np.asarray([7, 8, 9, 10, 11], np.int32))
+    d = pkt.decode(o.encode())
+    assert isinstance(d, pkt.PrepareBatch)
+    assert d.sender == 3
+    np.testing.assert_array_equal(d.gkey, o.gkey)
+    np.testing.assert_array_equal(d.bal, o.bal)
+
+
+def test_prepare_reply_batch_codec_roundtrip_ragged():
+    # 3 rows: windows of 2, 0, 1 entries (ragged, the idle-fleet shape)
+    o = pkt.PrepareReplyBatch(
+        9,
+        np.asarray([11, 22, 33], np.uint64),
+        np.asarray([5, 6, 7], np.int32),
+        np.asarray([1, 0, 1], np.uint8),
+        np.asarray([4, 0, 2], np.int32),
+        np.asarray([2, 0, 1], np.int32),
+        np.asarray([4, 5, 2], np.int32),
+        np.asarray([3, 3, 1], np.int32),
+        np.asarray([100, 101, 102], np.int32),
+        np.asarray([0, 0, 1], np.int32),
+        [b"\x00aa", b"\x04", b"\x00b"])
+    d = pkt.decode(o.encode())
+    assert isinstance(d, pkt.PrepareReplyBatch)
+    assert d.sender == 9
+    np.testing.assert_array_equal(d.counts, o.counts)
+    np.testing.assert_array_equal(d.slots, o.slots)
+    np.testing.assert_array_equal(d.req_hi, o.req_hi)
+    assert d.payloads == o.payloads
+    assert not d.acked[1] and d.acked[2]
+
+
+def test_mass_takeover_batched(tmp_path):
+    """600 groups (past the 64-row batch threshold) all led by one node;
+    kill it; the successor must install itself for every one and keep
+    serving."""
+    n_groups = 600
+    victim = 0
+    names = []
+    i = 0
+    while len(names) < n_groups:
+        nm = f"mf{i}"
+        i += 1
+        if group_key(nm) % 3 == victim:
+            names.append(nm)
+    emu = PaxosEmulation(str(tmp_path), n_nodes=3, n_groups=0,
+                         group_size=3, backend="native",
+                         capacity=2048, ping_interval_s=0.15,
+                         failure_timeout_s=1.0)
+    try:
+        emu.create_groups(len(names), names=names)
+        pre = emu.run_load(60, concurrency=16, timeout=tscale(10))
+        assert pre["ok"] == 60
+        time.sleep(0.5)  # pings establish last_heard
+        successor = (victim + 1) % 3
+        node = emu.nodes[successor]
+        assert node.n_installs == 0, "spurious elections before the kill"
+        emu.kill(victim)
+        deadline = time.time() + tscale(30)
+        while time.time() < deadline and (
+                node.n_installs < n_groups or node._elections):
+            time.sleep(0.1)
+        assert node.n_installs >= n_groups, (
+            f"only {node.n_installs}/{n_groups} groups taken over "
+            f"(elections left: {len(node._elections)})")
+        # liveness through the new regime: every request decided
+        post = emu.run_load(60, concurrency=16, timeout=tscale(15),
+                            client_id=1 << 21)
+        assert post["ok"] == 60, f"post-takeover load failed: {post}"
+        # the new coordinator is the successor on a sampled row
+        from gigapaxos_tpu.ops.types import unpack_ballot
+        row = node.table.by_name(names[0]).row
+        num, coord = unpack_ballot(int(node._bal[row]))
+        assert coord == successor and num >= 1
+    finally:
+        emu.stop()
