@@ -402,15 +402,28 @@ def failover_mass(args) -> dict:
         if group_key(nm) % 5 == victim:
             names.append(nm)
     cap = max(args.capacity, args.groups + 1024)
+    # this mode measures TAKEOVER: the idle-pause deactivator would
+    # otherwise start sweeping mid-create at this scale (create wall >
+    # PAUSE_IDLE_S), making creates superlinear and parking a chunk of
+    # the fleet out of the election path
+    from gigapaxos_tpu.paxos.paxosconfig import PC
+    from gigapaxos_tpu.utils.config import Config
+    Config.set(PC.PAUSE_IDLE_S, 0.0)
+    # boot LENIENT: a 16K-row create chunk stalls a worker past any
+    # aggressive failure timeout, and spurious mid-create elections
+    # corrupt the measurement; detection is tightened after the fleet
+    # settles (attributes are read per tick, so post-boot flips apply)
     emu = PaxosEmulation(args.logdir, n_nodes=5, n_groups=0,
                          group_size=5, backend=args.backend,
                          capacity=cap, window=args.window,
                          sync_wal=args.sync_wal, ping_interval_s=0.15,
-                         failure_timeout_s=1.0)
+                         failure_timeout_s=600.0)
     try:
         t0 = time.perf_counter()
         emu.create_groups(len(names), names=names)
         t_create = time.perf_counter() - t0
+        for nd in emu.nodes.values():
+            nd.failure_timeout = 1.0
         conc = min(args.concurrency, 448)
         pre = emu.run_load(min(args.requests, 5000), concurrency=conc)
         time.sleep(0.5)  # let pings establish last_heard
