@@ -855,6 +855,8 @@ class PaxosNode:
     # ------------------------------------------------------------------
 
     def _worker_loop(self) -> None:
+        if bool(Config.get(PC.PIPELINE_WORKER)):
+            return self._worker_loop_pipelined()
         prev_items = 0
         while not self._stopping:
             try:
@@ -905,6 +907,81 @@ class PaxosNode:
             DelayProfiler.update_delay("node.batch", t0, len(batch))
             with self._engine_lock:
                 self._tick()
+
+    def _worker_loop_pipelined(self) -> None:
+        """Two-stage worker (PC.PIPELINE_WORKER; SURVEY §7.1 "build
+        batch N+1 on host while the kernel runs batch N"): this thread
+        collects + decodes; a process thread runs engine + WAL + sends.
+        The hand-off queue is depth-2 — one batch in flight, one being
+        built — so memory stays bounded and backpressure reaches the
+        socket the same way the single-stage loop's service rate does.
+        All engine/mirror state stays single-writer (the process thread
+        + the engine lock); decode is stateless."""
+        stage: "queue_mod.Queue" = queue_mod.Queue(maxsize=2)
+
+        def proc_loop() -> None:
+            while True:
+                try:
+                    decoded = stage.get(timeout=self.batch_timeout)
+                except queue_mod.Empty:
+                    with self._engine_lock:
+                        self._tick()
+                    continue
+                if decoded is None:
+                    return
+                t0 = time.monotonic()
+                try:
+                    with self._engine_lock:
+                        self._process(decoded)
+                except Exception:
+                    log.exception("pipelined batch failed (%d items)",
+                                  len(decoded))
+                DelayProfiler.update_total("w.process", t0, len(decoded))
+                DelayProfiler.update_delay("node.batch", t0,
+                                           len(decoded))
+                with self._engine_lock:
+                    self._tick()
+
+        proc = threading.Thread(target=proc_loop, daemon=True,
+                                name=f"gp-node{self.id}-proc")
+        proc.start()
+        prev_items = 0
+        try:
+            while not self._stopping:
+                try:
+                    first = self._inq.get(timeout=self.batch_timeout)
+                except queue_mod.Empty:
+                    continue  # proc thread ticks on its own timeout
+                if first is None:
+                    break
+                if prev_items >= self.batch_busy and \
+                        self.batch_coalesce > 0:
+                    time.sleep(self.batch_coalesce)
+                batch = [first]
+                n_frames = len(first) if isinstance(first, list) else 1
+                while n_frames < self.batch_size:
+                    try:
+                        nxt = self._inq.get_nowait()
+                    except queue_mod.Empty:
+                        break
+                    if nxt is None:
+                        self._stopping = True
+                        break
+                    batch.append(nxt)
+                    n_frames += len(nxt) if isinstance(nxt, list) else 1
+                prev_items = n_frames
+                t0 = time.monotonic()
+                try:
+                    decoded = self._decode_batch(batch)
+                except Exception:
+                    log.exception("pipelined decode failed (%d items)",
+                                  len(batch))
+                    continue
+                DelayProfiler.update_total("w.decode", t0, len(batch))
+                stage.put(decoded)  # blocks at depth 2: backpressure
+        finally:
+            stage.put(None)
+            proc.join(5)
 
     def _tick(self) -> None:
         """Periodic duties: failure detection → run-for-coordinator.

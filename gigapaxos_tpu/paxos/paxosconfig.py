@@ -73,6 +73,16 @@ class PC(ConfigKey):
     # beyond this many per second are answered status 1 ("retry") at the
     # door instead of admitted to the pipeline; 0 disables
     MAX_INTAKE_RPS = 0
+    # two-stage worker pipeline (SURVEY §7.1 host<->device overlap, the
+    # PP analog): an intake thread collects + decodes batch k+1 while
+    # the process thread runs batch k's backend call + WAL fsync + sends
+    # — those release the GIL (ctypes engine, XLA dispatch, fsync), so
+    # decode overlaps them even on one core, and on a real accelerator
+    # the device step runs concurrently with host-side batch building.
+    # Off by default: on a saturated single core the second thread adds
+    # GIL hand-offs on the latency path; measure per deployment
+    # (testing.main throughput --pipeline prints the A/B).
+    PIPELINE_WORKER = False
     # per-stage CPU-seconds accounting (DelayProfiler update_total
     # cpu column).  Off by default: thread_time() is a real syscall
     # (~6 us — no vDSO for CLOCK_THREAD_CPUTIME_ID) and the worker

@@ -51,9 +51,11 @@ def mode_throughput(args) -> dict:
             "concurrency": 32, "throughput_rps": lat["throughput_rps"],
             "lat_p50_ms": lat["lat_p50_ms"],
             "lat_p99_ms": lat["lat_p99_ms"]}
+        stats["pipeline_worker"] = bool(args.pipeline)
         return {
             "metric": f"e2e decided req/s, {args.nodes} replicas, "
-                      f"{args.groups} groups ({args.backend}), "
+                      f"{args.groups} groups ({args.backend}"
+                      f"{', pipelined' if args.pipeline else ''}), "
                       f"depth {args.concurrency}",
             "value": stats["throughput_rps"], "unit": "req/s",
             "info": stats,
@@ -522,6 +524,9 @@ def main(argv=None) -> int:
     p.add_argument("--via-reconfigurator", action="store_true",
                    help="churn mode: drive creates/deletes through the "
                         "reconfiguration control plane (epoch FSM)")
+    p.add_argument("--pipeline", action="store_true",
+                   help="two-stage worker (PC.PIPELINE_WORKER): decode "
+                        "batch k+1 while batch k's engine+WAL+send runs")
     p.add_argument("--single-coordinator", action="store_true",
                    help="failover mode: every group's initial "
                         "coordinator is the SAME node (names filtered "
@@ -543,6 +548,10 @@ def main(argv=None) -> int:
         Config.set(PC.COLUMNAR_DEVICE, "default")
     else:
         jax.config.update("jax_platforms", "cpu")
+    if args.pipeline:
+        from gigapaxos_tpu.paxos.paxosconfig import PC
+        from gigapaxos_tpu.utils.config import Config
+        Config.set(PC.PIPELINE_WORKER, True)
     if args.logdir is None:
         args.logdir = tempfile.mkdtemp(prefix="gp_bench_")
     out = {"throughput": mode_throughput, "churn": mode_churn,
