@@ -1,0 +1,55 @@
+"""Two-stage worker pipeline (PC.PIPELINE_WORKER; SURVEY §7.1 overlap).
+
+The pipelined intake/process split must preserve every worker-loop
+behavior the single-stage loop provides: request → decide → execute →
+reply, periodic ticks (failure detection / parked flush), and clean
+shutdown.  Runs the same multi-node loopback flow the e2e suite uses,
+with the knob ON.
+"""
+
+import time
+
+from gigapaxos_tpu.paxos.paxosconfig import PC
+from gigapaxos_tpu.testing.harness import PaxosEmulation
+from gigapaxos_tpu.utils.config import Config
+
+from tests.conftest import tscale
+
+
+def test_pipelined_worker_e2e(tmp_path):
+    Config.set(PC.PIPELINE_WORKER, True)
+    emu = PaxosEmulation(str(tmp_path), n_nodes=3, n_groups=64,
+                         backend="native")
+    try:
+        stats = emu.run_load(500, concurrency=64, timeout=tscale(15))
+        assert stats["ok"] == 500, stats
+        # three replicas converge on the same execution count
+        deadline = time.time() + tscale(10)
+        while time.time() < deadline:
+            if len({nd.n_executed for nd in emu.nodes.values()}) == 1:
+                break
+            time.sleep(0.05)
+        assert len({nd.n_executed for nd in emu.nodes.values()}) == 1
+    finally:
+        emu.stop()
+
+
+def test_pipelined_worker_failover(tmp_path):
+    """Ticks (failure detection + elections) must still run when the
+    process thread owns them: kill a coordinator and require liveness."""
+    Config.set(PC.PIPELINE_WORKER, True)
+    emu = PaxosEmulation(str(tmp_path), n_nodes=3, n_groups=32,
+                         backend="native", ping_interval_s=0.15,
+                         failure_timeout_s=1.0)
+    try:
+        pre = emu.run_load(64, concurrency=16, timeout=tscale(10))
+        assert pre["ok"] == 64
+        time.sleep(0.5)
+        from gigapaxos_tpu.paxos.packets import group_key
+        victim = group_key(emu.groups[0]) % 3
+        emu.kill(victim)
+        post = emu.run_load(64, concurrency=16, timeout=tscale(20),
+                            client_id=1 << 21)
+        assert post["ok"] == 64, f"liveness lost across failover: {post}"
+    finally:
+        emu.stop()
