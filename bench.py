@@ -365,6 +365,11 @@ def run_full(args) -> int:
     sub("config5_failover_5r",
         m + ["failover", "--requests", "1000" if q else "5000"],
         300 if q else 420)
+    sub("config5b_mass_takeover_100k",
+        m + ["failover", "--single-coordinator",
+             "--groups", "5000" if q else "100000",
+             "--requests", "1000"],
+        300 if q else 420)
 
     out = {
         "recorded_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),

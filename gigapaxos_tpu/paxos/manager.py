@@ -907,7 +907,11 @@ class PaxosNode:
                 DelayProfiler.update_total("w.process", t1, len(batch),
                                            cpu_t0=c1)
             except Exception:
-                log.exception("worker batch failed (%d items)", len(batch))
+                if not self._stopping:
+                    log.exception("worker batch failed (%d items)",
+                                  len(batch))
+                # else: crash-stop teardown races (closed DB / closed
+                # event loop) are the emulated crash, not errors
             DelayProfiler.update_delay("node.batch", t0, len(batch))
             with self._engine_lock:
                 self._tick()
@@ -938,8 +942,9 @@ class PaxosNode:
                     with self._engine_lock:
                         self._process(decoded)
                 except Exception:
-                    log.exception("pipelined batch failed (%d items)",
-                                  len(decoded))
+                    if not self._stopping:
+                        log.exception("pipelined batch failed "
+                                      "(%d items)", len(decoded))
                 DelayProfiler.update_total("w.process", t0, len(decoded))
                 DelayProfiler.update_delay("node.batch", t0,
                                            len(decoded))
@@ -1199,7 +1204,11 @@ class PaxosNode:
             self._flush_responses()
             out, self._out_buf = self._out_buf, None
             if out and self._loop is not None:
-                self.transport.send_many_threadsafe(out)
+                try:
+                    self.transport.send_many_threadsafe(out)
+                except RuntimeError:
+                    if not self._stopping:  # closed loop mid-crash-stop
+                        raise
 
     def _process_inner(self, batch: List) -> None:
         by_type: Dict[type, List] = {}
