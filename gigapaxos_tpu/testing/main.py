@@ -338,7 +338,32 @@ def mode_scale(args) -> dict:
         finally:
             cli.close()
         assert status == 0, f"request on group m{n - 1} failed: {status}"
-        return {
+        recover = None
+        if args.restart:
+            # SURVEY §7.3.6 "recovery at 1M groups": reboot the node
+            # from its durable state and require the tail group to
+            # serve again.  Measures cold boot (batched recovery +
+            # lazy checkpoint hydration), not just the create path.
+            node.stop()
+            t0 = time.perf_counter()
+            node = PaxosNode(0, addr, NoopApp(), args.logdir,
+                             backend=args.backend,
+                             capacity=max(args.capacity, n),
+                             window=args.window)
+            node.start()
+            t_boot = time.perf_counter() - t0
+            assert len(node.table) == n, \
+                f"recovered {len(node.table)}/{n} groups"
+            cli = PaxosClient([addr[0]], timeout=60)
+            try:
+                st2 = cli.send_request(f"m{n - 1}", b"ping2").status
+            finally:
+                cli.close()
+            assert st2 == 0, f"post-recovery request failed: {st2}"
+            recover = {"recover_s": round(t_boot, 2),
+                       "groups_per_s": round(n / t_boot, 1),
+                       "tail_request_status": st2}
+        out = {
             "metric": f"live-runtime group capacity: {n} groups, one "
                       f"node ({args.backend})",
             "value": round(made / wall, 1), "unit": "creates/s",
@@ -347,6 +372,9 @@ def mode_scale(args) -> dict:
                      "bytes_per_group": round(rss_kb * 1024 / made),
                      "tail_request_status": status},
         }
+        if recover:
+            out["info"]["recovery"] = recover
+        return out
     finally:
         node.stop()
 
@@ -524,6 +552,10 @@ def main(argv=None) -> int:
     p.add_argument("--via-reconfigurator", action="store_true",
                    help="churn mode: drive creates/deletes through the "
                         "reconfiguration control plane (epoch FSM)")
+    p.add_argument("--restart", action="store_true",
+                   help="scale mode: stop + reboot the node from its "
+                        "durable state and time the recovery (SURVEY "
+                        "§7.3.6 'recovery at 1M groups')")
     p.add_argument("--pipeline", action="store_true",
                    help="two-stage worker (PC.PIPELINE_WORKER): decode "
                         "batch k+1 while batch k's engine+WAL+send runs")
