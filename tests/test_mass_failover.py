@@ -12,6 +12,7 @@ batch path, not the scalar per-row election machinery).
 import time
 
 import numpy as np
+import pytest
 
 from gigapaxos_tpu.paxos import packets as pkt
 from gigapaxos_tpu.paxos.packets import group_key
@@ -55,11 +56,14 @@ def test_prepare_reply_batch_codec_roundtrip_ragged():
     assert not d.acked[1] and d.acked[2]
 
 
-def test_mass_takeover_batched(tmp_path):
-    """600 groups (past the 64-row batch threshold) all led by one node;
-    kill it; the successor must install itself for every one and keep
-    serving."""
-    n_groups = 600
+@pytest.mark.parametrize("backend", ["native", "columnar", "scalar"])
+def test_mass_takeover_batched(tmp_path, backend):
+    """Groups past the 64-row batch threshold all led by one node; kill
+    it; the successor must install itself for every one and keep
+    serving.  All three engines: the batch handlers lean on the SPI's
+    compacted-left prepare-window contract, which each engine implements
+    differently."""
+    n_groups = 600 if backend == "native" else 192
     victim = 0
     names = []
     i = 0
@@ -69,7 +73,7 @@ def test_mass_takeover_batched(tmp_path):
         if group_key(nm) % 3 == victim:
             names.append(nm)
     emu = PaxosEmulation(str(tmp_path), n_nodes=3, n_groups=0,
-                         group_size=3, backend="native",
+                         group_size=3, backend=backend,
                          capacity=2048, ping_interval_s=0.15,
                          failure_timeout_s=1.0)
     try:
