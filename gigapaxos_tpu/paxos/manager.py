@@ -1320,12 +1320,15 @@ class PaxosNode:
                 log.warning("unhandled packet type %s x%d", t.__name__,
                             len(objs))
                 continue
+            t0 = time.monotonic()
             for o in objs:
                 for h in handlers:
                     try:
                         h(o)
                     except Exception:
                         log.exception("handler %r failed", h)
+            DelayProfiler.update_total(f"w.upper.{t.__name__}", t0,
+                                       len(objs))
 
     def register_handler(self, ptype: type, fn) -> None:
         """Register an upper-layer handler for a packet class (called on
