@@ -557,6 +557,42 @@ def accept_reply_packed(state: ColumnarState, packed):
         o.req_lo, o.req_hi, o.dec_slot])
 
 
+def propose_accept_self_packed(state: ColumnarState, packed):
+    """packed[5, B]: g, rlo, rhi, self_member_idx, valid -> out[9, B]:
+    granted, rejected, throttled, slot, cbal, self_acked,
+    newly_decided, preempted, acc_cur_bal.
+
+    Fused coordinator fast path (SURVEY §7.1 — minimize device round
+    trips): propose + THIS node's own accept + own accept-reply vote in
+    ONE device call.  The unfused runtime bounced the coordinator's own
+    AcceptBatch through the loopback self-wave, costing two more kernel
+    calls (and, on a remote accelerator, two more link round trips) per
+    batch.  Other members' accepts still ride the wire; their replies
+    land in :func:`accept_reply_batch` as before.
+
+    Semantics preserved exactly:
+    - the self-accept can NACK (a competitor's higher prepare landed
+      between our install and this batch) — its promised ballot rides
+      ``acc_cur_bal`` and drives in-kernel preemption, like the nack
+      reply did on the loopback path;
+    - single-member groups reach quorum on the self vote alone —
+      ``newly_decided`` surfaces the decision for the host commit path.
+    """
+    g, rlo, rhi, smidx = packed[0], packed[1], packed[2], packed[3]
+    valid = packed[4] != 0
+    state, po = propose_batch(state, g, rlo, rhi, valid)
+    gr = valid & po.granted
+    state, ao = accept_batch(state, g, po.slot, po.cbal, rlo, rhi, gr)
+    reply_bal = jnp.where(ao.acked, po.cbal, ao.cur_bal)
+    state, ro = accept_reply_batch(state, g, po.slot, reply_bal, smidx,
+                                   ao.acked, gr)
+    return state, jnp.stack([
+        po.granted.astype(i32), po.rejected.astype(i32),
+        po.throttled.astype(i32), po.slot, po.cbal,
+        (gr & ao.acked).astype(i32), ro.newly_decided.astype(i32),
+        ro.preempted.astype(i32), ao.cur_bal])
+
+
 def commit_packed(state: ColumnarState, packed):
     """packed[5, B]: g, slot, rlo, rhi, valid -> out[4, B]: applied,
     stale, out_window, new_cursor."""
@@ -579,6 +615,8 @@ accept_reply = jax.jit(accept_reply_batch, donate_argnums=0)
 propose = jax.jit(propose_batch, donate_argnums=0)
 commit = jax.jit(commit_batch, donate_argnums=0)
 propose_p = jax.jit(propose_packed, donate_argnums=0)
+propose_accept_self_p = jax.jit(propose_accept_self_packed,
+                                donate_argnums=0)
 accept_p = jax.jit(accept_packed, donate_argnums=0)
 accept_reply_p = jax.jit(accept_reply_packed, donate_argnums=0)
 commit_p = jax.jit(commit_packed, donate_argnums=0)

@@ -605,6 +605,23 @@ class ColumnarBackend(AcceptorBackend):
         out = np.asarray(o)[:, :n]
         return CommitRes(out[0] != 0, out[1] != 0, out[2] != 0, out[3])
 
+    def propose_self(self, rows, req_ids, self_midx):
+        """Fused propose + own accept + own vote (ONE device call; see
+        kernels.propose_accept_self_packed).  Returns (ProposeRes,
+        self_acked[B], newly_decided[B], preempted[B], acc_cur_bal[B])
+        — the last two surface what the loopback self-wave's nack reply
+        used to carry."""
+        n = len(rows)
+        lo, hi = _split64(req_ids)
+        self.state, o = self._k.propose_accept_self_p(
+            self.state, self._packed(
+                n, (rows, 0), (lo, 0), (hi, 0), (self_midx, 0)))
+        out = np.asarray(o)[:, :n]
+        granted = out[0] != 0
+        pr = ProposeRes(granted, out[1] != 0, out[2] != 0,
+                        np.where(granted, out[3], NO_SLOT), out[4])
+        return pr, out[5] != 0, out[6] != 0, out[7] != 0, out[8]
+
     def prepare(self, rows, bals) -> PrepareRes:
         n = len(rows)
         self.state, o = self._k.prepare(

@@ -161,6 +161,12 @@ class PaxosNode:
         # batch sizes) disappears
         self._fused = self.backend.store \
             if isinstance(self.backend, NativeBackend) else None
+        # fused columnar coordinator path (propose + own accept + own
+        # vote in ONE device call — kernels.propose_accept_self_packed):
+        # cuts two kernel calls AND the loopback self-wave per batch,
+        # which on a remote accelerator is two fewer link round trips
+        self._col_self = self.backend \
+            if isinstance(self.backend, ColumnarBackend) else None
         self.table = GroupTable(cap)
         self.logger = PaxosLogger(
             logdir, sync=bool(Config.get(PC.SYNC_WAL)),
@@ -1609,7 +1615,15 @@ class PaxosNode:
         rows = np.concatenate(rows_parts).astype(np.int32, copy=False)
         req_ids = np.concatenate(req_parts)
         self._la[rows] = now
-        res = self.backend.propose(rows, req_ids)
+        if self._col_self is not None:
+            smidx = np.argmax(
+                self._member_mat[rows] == self.id, axis=1).astype(
+                    np.int32)
+            res, self_acked, self_newly, self_pre, self_cur = \
+                self.backend.propose_self(rows, req_ids, smidx)
+        else:
+            self_acked = None
+            res = self.backend.propose(rows, req_ids)
         granted = np.asarray(res.granted)
         bal_of = self._bal[rows]
         slot_arr = np.asarray(res.slot)
@@ -1633,9 +1647,74 @@ class PaxosNode:
                 if meta is not None and unpack_ballot(
                         int(self._bal[row]))[1] == self.id:
                     self._start_election(row, meta)
-        self._emit_accepts(rows, req_ids, flag_parts, pay_parts, res)
+        if self_acked is not None:
+            self._after_propose_self(rows, req_ids, flag_parts,
+                                     pay_parts, res, self_acked,
+                                     self_newly, self_pre, self_cur,
+                                     now)
+        self._emit_accepts(rows, req_ids, flag_parts, pay_parts, res,
+                           skip_self=self_acked is not None)
 
-    def _emit_accepts(self, rows, req_ids, flags, payloads, res) -> None:
+    def _after_propose_self(self, rows, req_ids, flags, payloads, res,
+                            self_acked, self_newly, self_pre, self_cur,
+                            now) -> None:
+        """Host bookkeeping for the fused self-accept/vote: everything
+        the loopback self-wave (_handle_accepts + _handle_accept_replies
+        on our own frames) used to do — WAL durability BEFORE anything
+        leaves this batch, acceptor mirrors, preemption adoption, and
+        commits for single-member quorums."""
+        ai = np.flatnonzero(self_acked)
+        if len(ai):
+            arows = rows[ai]
+            slots_g = np.asarray(res.slot)[ai].astype(np.int32)
+            cbals = np.asarray(res.cbal)[ai].astype(np.int32)
+            np.maximum.at(self._acc_hi, arows, slots_g)
+            self._acc_ts[arows] = now
+            np.maximum.at(self._bal, arows, cbals)
+            blobs = [bytes([flags[i]]) + payloads[i] if payloads[i]
+                     or flags[i] else b"\x00" for i in ai.tolist()]
+            wal_buf = native.encode_wal(
+                np.full(len(ai), REC_ACCEPT, np.uint8),
+                self._row_gkey[arows], slots_g, cbals, req_ids[ai],
+                blobs)
+            # durability barrier: the self vote counts toward quorums,
+            # so it must be durable before any resulting decision (or
+            # remote accept) leaves this batch
+            self.logger.log_raw_inline(wal_buf, n_entries=len(ai))
+            if RequestInstrumenter.enabled:
+                for r in req_ids[ai].tolist():
+                    RequestInstrumenter.record(int(r), "acc", self.id)
+        pre = np.flatnonzero(self_pre)
+        if len(pre):
+            # our own acceptor outranked us (competitor's prepare landed
+            # first): adopt the higher promise; the kernel already
+            # resigned coordinatorship
+            np.maximum.at(self._bal, rows[pre],
+                          np.asarray(self_cur)[pre].astype(np.int32))
+        ni = np.flatnonzero(self_newly)
+        if len(ni):
+            # single-member quorum: decided on our own vote
+            self.n_decided += len(ni)
+            nrows = rows[ni]
+            reqs = req_ids[ni]
+            self._emit_commits(
+                nrows, self._row_gkey[nrows],
+                np.asarray(res.slot)[ni].astype(np.int32),
+                np.asarray(res.cbal)[ni].astype(np.int32),
+                *_split_reqs(reqs))
+
+    def _emit_commits(self, nrows, gkeys, slots, bals, rlo, rhi) -> None:
+        """CommitBatch per member destination for newly decided lanes."""
+        dsts = self._member_mat[nrows]
+        for dst in np.unique(dsts):
+            if dst < 0:
+                continue
+            m = (dsts == dst).any(axis=1)
+            self._route(int(dst), pkt.CommitBatch(
+                self.id, gkeys[m], slots[m], bals[m], rlo[m], rhi[m]))
+
+    def _emit_accepts(self, rows, req_ids, flags, payloads, res,
+                      skip_self: bool = False) -> None:
         """Granted lanes → AcceptBatch per member destination (one mask
         per dst over the membership matrix; gkeys come from the row->gkey
         array, pinned u64 — a bare np.asarray of mixed int magnitudes
@@ -1655,7 +1734,9 @@ class PaxosNode:
         pls = [bytes([flags[i]]) + payloads[i] for i in gi.tolist()]
         dsts = self._member_mat[rows_g]
         for dst in np.unique(dsts):
-            if dst < 0:
+            if dst < 0 or (skip_self and dst == self.id):
+                # fused path: our own accept + vote already happened
+                # inside the propose kernel call
                 continue
             m = (dsts == dst).any(axis=1)
             self._route(int(dst), pkt.AcceptBatch(
@@ -1801,21 +1882,12 @@ class PaxosNode:
             if RequestInstrumenter.enabled:
                 for r in dreq.tolist():
                     RequestInstrumenter.record(int(r), "dec", self.id)
-            cb_gkey = gkeys[newly]
-            cb_slot = slots_a[newly]
-            cb_bal = dec_bal[newly]
             cb_rlo = (dreq & np.uint64(0xFFFFFFFF)).astype(
                 np.uint32).view(np.int32)
             cb_rhi = (dreq >> np.uint64(32)).astype(np.uint32).view(
                 np.int32)
-            dsts = self._member_mat[nrows]
-            for dst in np.unique(dsts):
-                if dst < 0:
-                    continue
-                m = (dsts == dst).any(axis=1)
-                self._route(int(dst), pkt.CommitBatch(
-                    self.id, cb_gkey[m], cb_slot[m], cb_bal[m],
-                    cb_rlo[m], cb_rhi[m]))
+            self._emit_commits(nrows, gkeys[newly], slots_a[newly],
+                               dec_bal[newly], cb_rlo, cb_rhi)
             return
         # sender -> member index, vectorized over the membership matrix
         mm = self._member_mat[np.where(all_rows >= 0, all_rows, 0)]
@@ -1844,20 +1916,11 @@ class PaxosNode:
         self.n_decided += int(newly.sum())
         # decisions -> CommitBatch to each member (incl. self loopback);
         # destinations come from the membership matrix, one mask per dst
-        nrows = rows[newly]
-        cb_gkey = gkeys[sel][newly]
-        cb_slot = slots[newly]
-        cb_bal = np.asarray(res.dec_bal)[newly].astype(np.int32)
-        cb_rlo = np.asarray(res.req_lo)[newly].astype(np.int32)
-        cb_rhi = np.asarray(res.req_hi)[newly].astype(np.int32)
-        dsts = self._member_mat[nrows]
-        for dst in np.unique(dsts):
-            if dst < 0:
-                continue
-            m = (dsts == dst).any(axis=1)
-            self._route(int(dst), pkt.CommitBatch(
-                self.id, cb_gkey[m], cb_slot[m], cb_bal[m], cb_rlo[m],
-                cb_rhi[m]))
+        self._emit_commits(
+            rows[newly], gkeys[sel][newly], slots[newly],
+            np.asarray(res.dec_bal)[newly].astype(np.int32),
+            np.asarray(res.req_lo)[newly].astype(np.int32),
+            np.asarray(res.req_hi)[newly].astype(np.int32))
 
     # -- commits → execution -------------------------------------------
 

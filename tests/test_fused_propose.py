@@ -1,0 +1,106 @@
+"""Fused coordinator kernel (`propose_accept_self_packed`) parity.
+
+The fused call must leave the device state and outputs EXACTLY as the
+sequential propose → accept(self) → accept_reply(self vote) calls did —
+it is the same three pure kernels composed in one jit program.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from gigapaxos_tpu.ops import kernels, make_state, pack_ballot
+from gigapaxos_tpu.ops.types import split_req_id
+
+
+def _mkstate(G=8, W=8, me=1, members=3):
+    st = make_state(G, W)
+    rows = jnp.arange(G, dtype=jnp.int32)
+    # groups 0..5: members (0,1,2) with coordinator=me; 6..7 single-member
+    mem = jnp.where(rows < 6, members, 1)
+    init = jnp.full(G, pack_ballot(0, me), jnp.int32)
+    st, _ = kernels.create_groups(
+        st, rows, mem, jnp.zeros(G, jnp.int32), init,
+        jnp.ones(G, bool), jnp.ones(G, bool))
+    return st
+
+
+def _pack(cols, B):
+    out = np.zeros((len(cols) + 1, B), np.int32)
+    for i, c in enumerate(cols):
+        out[i, :len(c)] = c
+    out[len(cols), :len(cols[0])] = 1
+    return jnp.asarray(out)
+
+
+def test_fused_matches_sequential():
+    me = 1
+    g = np.asarray([0, 0, 3, 6, 7], np.int32)     # 6,7 single-member
+    reqs = np.asarray([101, 102, 103, 104, 105], np.uint64)
+    lo, hi = zip(*[split_req_id(int(r)) for r in reqs])
+    smidx = np.asarray([1, 1, 1, 0, 0], np.int32)  # member idx of `me`
+    B = 8
+
+    # fused
+    st_f = _mkstate(me=me)
+    st_f, out = kernels.propose_accept_self_p(
+        st_f, _pack([g, lo, hi, smidx], B))
+    out = np.asarray(out)[:, :len(g)]
+
+    # sequential on an identical state
+    st_s = _mkstate(me=me)
+    pad = lambda a, fill=0: jnp.asarray(  # noqa: E731
+        np.concatenate([a, np.full(B - len(a), fill, a.dtype)]))
+    valid = jnp.asarray([True] * len(g) + [False] * (B - len(g)))
+    st_s, po = kernels.propose(st_s, pad(g), pad(np.asarray(lo, np.int32)),
+                               pad(np.asarray(hi, np.int32)), valid)
+    gr = valid & po.granted
+    st_s, ao = kernels.accept(st_s, pad(g), po.slot, po.cbal,
+                              pad(np.asarray(lo, np.int32)),
+                              pad(np.asarray(hi, np.int32)), gr)
+    reply_bal = jnp.where(ao.acked, po.cbal, ao.cur_bal)
+    st_s, ro = kernels.accept_reply(st_s, pad(g), po.slot, reply_bal,
+                                    pad(smidx), ao.acked, gr)
+
+    n = len(g)
+    np.testing.assert_array_equal(out[0] != 0, np.asarray(po.granted)[:n])
+    np.testing.assert_array_equal(out[3], np.asarray(po.slot)[:n])
+    np.testing.assert_array_equal(out[4], np.asarray(po.cbal)[:n])
+    np.testing.assert_array_equal(out[5] != 0,
+                                  np.asarray(gr & ao.acked)[:n])
+    np.testing.assert_array_equal(out[6] != 0,
+                                  np.asarray(ro.newly_decided)[:n])
+    # single-member groups decided on the self vote alone; 3-member not
+    assert (out[6] != 0).tolist() == [False, False, False, True, True]
+
+    # the device state is bit-identical
+    for f, a, b in zip(st_f._fields, jax.tree_util.tree_leaves(st_f),
+                       jax.tree_util.tree_leaves(st_s)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b),
+                                      err_msg=f"state field {f} diverged")
+
+
+def test_fused_nack_preempts():
+    """A higher promise on our own acceptor (competitor prepared between
+    install and propose) must nack the self-accept and resign
+    coordinatorship, like the loopback nack reply did."""
+    me = 1
+    st = _mkstate(me=me)
+    # bump group 0's promise above our cbal
+    higher = pack_ballot(5, 2)
+    st, _ = kernels.prepare(
+        st, jnp.asarray([0] * 8, jnp.int32),
+        jnp.asarray([higher] * 8, jnp.int32),
+        jnp.asarray([True] + [False] * 7))
+    lo, hi = split_req_id(777)
+    st, out = kernels.propose_accept_self_p(
+        st, _pack([np.asarray([0], np.int32),
+                   np.asarray([lo], np.int32),
+                   np.asarray([hi], np.int32),
+                   np.asarray([1], np.int32)], 8))
+    out = np.asarray(out)[:, :1]
+    assert out[0][0] != 0          # propose granted (coordinator view)
+    assert out[5][0] == 0          # but the self-accept NACKED
+    assert out[7][0] != 0          # -> preempted
+    assert out[8][0] == higher     # promised ballot surfaced
+    assert not bool(st.is_coord[0])  # resigned in-kernel

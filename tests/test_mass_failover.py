@@ -85,7 +85,9 @@ def test_mass_takeover_batched(tmp_path, backend):
         node = emu.nodes[successor]
         assert node.n_installs == 0, "spurious elections before the kill"
         emu.kill(victim)
-        deadline = time.time() + tscale(30)
+        # generous: a COLD first compile of the columnar kernels (empty
+        # .jax_cache) can land mid-takeover and stall the worker ~10s+
+        deadline = time.time() + tscale(45)
         while time.time() < deadline and (
                 node.n_installs < n_groups or node._elections):
             time.sleep(0.1)
