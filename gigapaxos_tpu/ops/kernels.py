@@ -593,6 +593,29 @@ def propose_accept_self_packed(state: ColumnarState, packed):
         ro.preempted.astype(i32), ao.cur_bal])
 
 
+def accept_reply_commit_self_packed(state: ColumnarState, packed):
+    """packed[6, B]: g, slot, bal, sender_midx, acked, valid ->
+    out[9, B]: newly_decided, preempted, dec_bal, req_lo, req_hi,
+    dec_slot, applied, stale, new_cursor.
+
+    Fused decide wave (same motivation as
+    :func:`propose_accept_self_packed`): when a reply batch crosses
+    quorum, the coordinator's OWN commit applies in the same device
+    call — the loopback CommitBatch-to-self frame and its separate
+    commit kernel call disappear.  Remote members still get their
+    CommitBatch; out-of-window can't arise (a decided slot is inside
+    the window that voted it)."""
+    g, slot, bal = packed[0], packed[1], packed[2]
+    state, ro = accept_reply_batch(state, g, slot, bal, packed[3],
+                                   packed[4] != 0, packed[5] != 0)
+    state, co = commit_batch(state, g, ro.dec_slot, ro.req_lo,
+                             ro.req_hi, ro.newly_decided)
+    return state, jnp.stack([
+        ro.newly_decided.astype(i32), ro.preempted.astype(i32),
+        ro.dec_bal, ro.req_lo, ro.req_hi, ro.dec_slot,
+        co.applied.astype(i32), co.stale.astype(i32), co.new_cursor])
+
+
 def commit_packed(state: ColumnarState, packed):
     """packed[5, B]: g, slot, rlo, rhi, valid -> out[4, B]: applied,
     stale, out_window, new_cursor."""
@@ -617,6 +640,8 @@ commit = jax.jit(commit_batch, donate_argnums=0)
 propose_p = jax.jit(propose_packed, donate_argnums=0)
 propose_accept_self_p = jax.jit(propose_accept_self_packed,
                                 donate_argnums=0)
+accept_reply_commit_self_p = jax.jit(accept_reply_commit_self_packed,
+                                     donate_argnums=0)
 accept_p = jax.jit(accept_packed, donate_argnums=0)
 accept_reply_p = jax.jit(accept_reply_packed, donate_argnums=0)
 commit_p = jax.jit(commit_packed, donate_argnums=0)

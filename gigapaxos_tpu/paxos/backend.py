@@ -21,7 +21,7 @@ builds them straight from decoded struct-of-arrays packets.
 from __future__ import annotations
 
 import abc
-from typing import Dict, List, NamedTuple, Optional
+from typing import Dict, List, NamedTuple, Optional, Tuple
 
 import numpy as np
 
@@ -604,6 +604,28 @@ class ColumnarBackend(AcceptorBackend):
             n, (rows, 0), (slots, NO_SLOT), (lo, 0), (hi, 0)))
         out = np.asarray(o)[:, :n]
         return CommitRes(out[0] != 0, out[1] != 0, out[2] != 0, out[3])
+
+    def accept_reply_commit_self(self, rows, slots, bals, senders, acked
+                                 ) -> Tuple[AcceptReplyRes, np.ndarray,
+                                            np.ndarray]:
+        """Fused reply + own commit (ONE device call; see
+        kernels.accept_reply_commit_self_packed).  Returns
+        (AcceptReplyRes, applied[B], stale[B]) — the extra columns are
+        the coordinator's own commit result for newly-decided lanes
+        (execution is re-derived host-side from the decision dict, so
+        the device cursor is not surfaced)."""
+        n = len(rows)
+        self.state, o = self._k.accept_reply_commit_self_p(
+            self.state, self._packed(
+                n, (rows, 0), (slots, NO_SLOT), (bals, NO_BALLOT),
+                (senders, 0), (np.asarray(acked, np.int32), 0)))
+        out = np.asarray(o)[:, :n]
+        newly = out[0] != 0
+        res = AcceptReplyRes(
+            newly, out[1] != 0, np.where(newly, out[3], 0),
+            np.where(newly, out[4], 0),
+            np.where(newly, out[2], NO_BALLOT))
+        return res, out[6] != 0, out[7] != 0
 
     def propose_self(self, rows, req_ids, self_midx):
         """Fused propose + own accept + own vote (ONE device call; see

@@ -1671,8 +1671,8 @@ class PaxosNode:
             np.maximum.at(self._acc_hi, arows, slots_g)
             self._acc_ts[arows] = now
             np.maximum.at(self._bal, arows, cbals)
-            blobs = [bytes([flags[i]]) + payloads[i] if payloads[i]
-                     or flags[i] else b"\x00" for i in ai.tolist()]
+            blobs = [bytes([flags[i]]) + payloads[i]
+                     for i in ai.tolist()]
             wal_buf = native.encode_wal(
                 np.full(len(ai), REC_ACCEPT, np.uint8),
                 self._row_gkey[arows], slots_g, cbals, req_ids[ai],
@@ -1703,11 +1703,14 @@ class PaxosNode:
                 np.asarray(res.cbal)[ni].astype(np.int32),
                 *_split_reqs(reqs))
 
-    def _emit_commits(self, nrows, gkeys, slots, bals, rlo, rhi) -> None:
-        """CommitBatch per member destination for newly decided lanes."""
+    def _emit_commits(self, nrows, gkeys, slots, bals, rlo, rhi,
+                      skip_self: bool = False) -> None:
+        """CommitBatch per member destination for newly decided lanes.
+        ``skip_self``: the fused decide wave already applied our own
+        commit on-device (host bookkeeping in _after_self_commit)."""
         dsts = self._member_mat[nrows]
         for dst in np.unique(dsts):
-            if dst < 0:
+            if dst < 0 or (skip_self and dst == self.id):
                 continue
             m = (dsts == dst).any(axis=1)
             self._route(int(dst), pkt.CommitBatch(
@@ -1905,8 +1908,17 @@ class PaxosNode:
         rows = all_rows[sel]
         slots = slots_a[sel]
         bals = bals_a[sel]
-        res = self.backend.accept_reply(rows, slots, bals, sidx[sel],
-                                        acked_a[sel].astype(bool))
+        if self._col_self is not None:
+            # fused decide wave: our own commit applied in the same
+            # device call as the vote counting
+            res, c_applied, c_stale = \
+                self.backend.accept_reply_commit_self(
+                    rows, slots, bals, sidx[sel],
+                    acked_a[sel].astype(bool))
+        else:
+            c_applied = None
+            res = self.backend.accept_reply(rows, slots, bals, sidx[sel],
+                                            acked_a[sel].astype(bool))
         # preemption: a higher ballot exists; adopt belief, stop leading
         pre = np.asarray(res.preempted)
         np.maximum.at(self._bal, rows[pre], bals[pre])
@@ -1914,13 +1926,40 @@ class PaxosNode:
         if not newly.any():
             return
         self.n_decided += int(newly.sum())
-        # decisions -> CommitBatch to each member (incl. self loopback);
-        # destinations come from the membership matrix, one mask per dst
+        # decisions -> CommitBatch to each member; with the fused path
+        # our own commit already happened on-device, so only the host
+        # bookkeeping (WAL, decision dict, execution) remains for self
         self._emit_commits(
             rows[newly], gkeys[sel][newly], slots[newly],
             np.asarray(res.dec_bal)[newly].astype(np.int32),
             np.asarray(res.req_lo)[newly].astype(np.int32),
-            np.asarray(res.req_hi)[newly].astype(np.int32))
+            np.asarray(res.req_hi)[newly].astype(np.int32),
+            skip_self=c_applied is not None)
+        if c_applied is not None:
+            self._after_self_commit(
+                rows, gkeys[sel], slots, res, newly, c_applied, c_stale)
+
+    def _after_self_commit(self, rows, gkeys, slots, res, newly,
+                           applied, stale) -> None:
+        """Host side of the fused self-commit: what _commit_install did
+        for the loopback CommitBatch — decision WAL (async: decisions
+        are recoverable from peers), decision dict, execution."""
+        inst = newly & (applied | stale)
+        ii = np.flatnonzero(inst)
+        if not len(ii):
+            return
+        reqs = _merge_req(np.asarray(res.req_lo), np.asarray(res.req_hi))
+        self._la[rows[ii]] = time.time()
+        self.logger.log_raw_inline(native.encode_wal(
+            np.full(len(ii), REC_DECIDE, np.uint8), gkeys[ii],
+            slots[ii], np.zeros(len(ii), np.int32), reqs[ii], []),
+            fsync=False, n_entries=len(ii))
+        dec = self._dec
+        for i in ii.tolist():
+            dec.setdefault(int(rows[i]), {})[int(slots[i])] = \
+                int(reqs[i])
+        for row in np.unique(rows[ii]):
+            self._execute_row(int(row))
 
     # -- commits → execution -------------------------------------------
 

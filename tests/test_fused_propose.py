@@ -80,6 +80,58 @@ def test_fused_matches_sequential():
                                       err_msg=f"state field {f} diverged")
 
 
+def test_fused_reply_commit_matches_sequential():
+    """accept_reply_commit_self == accept_reply then commit(newly)."""
+    me = 1
+    g = np.asarray([0, 0, 3], np.int32)
+    reqs = [201, 202, 203]
+    lo, hi = zip(*[split_req_id(r) for r in reqs])
+    B = 8
+
+    def drive(fused):
+        st = _mkstate(me=me)
+        # propose + self-accept/vote (1 of 3 members voted)
+        st, out = kernels.propose_accept_self_p(
+            st, _pack([g, lo, hi, np.asarray([1, 1, 1], np.int32)], B))
+        out = np.asarray(out)[:, :len(g)]
+        slots = out[3]
+        cbals = out[4]
+        # second member's votes arrive -> quorum (2 of 3)
+        cols = [g, slots, cbals, np.asarray([0, 0, 0], np.int32),
+                np.asarray([1, 1, 1], np.int32)]
+        if fused:
+            st, ro = kernels.accept_reply_commit_self_p(
+                st, _pack(cols, B))
+            return st, np.asarray(ro)[:, :len(g)]
+        pad = lambda a, fill=0: jnp.asarray(  # noqa: E731
+            np.concatenate(
+                [np.asarray(a, np.int32),
+                 np.full(B - len(g), fill, np.int32)]))
+        valid = jnp.asarray([True] * len(g) + [False] * (B - len(g)))
+        st, r = kernels.accept_reply(st, pad(g), pad(slots), pad(cbals),
+                                     pad([0, 0, 0]),
+                                     jnp.asarray([True] * B), valid)
+        st, c = kernels.commit(st, pad(g), r.dec_slot, r.req_lo,
+                               r.req_hi, r.newly_decided)
+        return st, (r, c)
+
+    st_f, out_f = drive(True)
+    st_s, (r, c) = drive(False)
+    n = len(g)
+    np.testing.assert_array_equal(out_f[0] != 0,
+                                  np.asarray(r.newly_decided)[:n])
+    assert (out_f[0] != 0).all()  # quorum crossed on every lane
+    np.testing.assert_array_equal(out_f[6] != 0,
+                                  np.asarray(c.applied)[:n])
+    np.testing.assert_array_equal(out_f[8], np.asarray(c.new_cursor)[:n])
+    for f, a, b in zip(st_f._fields, jax.tree_util.tree_leaves(st_f),
+                       jax.tree_util.tree_leaves(st_s)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b),
+                                      err_msg=f"state field {f} diverged")
+    # cursor advanced: group 0 decided slots 0,1 -> cursor 2; group 3 -> 1
+    assert int(st_f.exec_cursor[0]) == 2 and int(st_f.exec_cursor[3]) == 1
+
+
 def test_fused_nack_preempts():
     """A higher promise on our own acceptor (competitor prepared between
     install and propose) must nack the self-accept and resign
