@@ -9,6 +9,8 @@ with the knob ON.
 
 import time
 
+import pytest
+
 from gigapaxos_tpu.paxos.paxosconfig import PC
 from gigapaxos_tpu.testing.harness import PaxosEmulation
 from gigapaxos_tpu.utils.config import Config
@@ -16,13 +18,22 @@ from gigapaxos_tpu.utils.config import Config
 from tests.conftest import tscale
 
 
-def test_pipelined_worker_e2e(tmp_path):
+@pytest.mark.parametrize("backend", ["native", "columnar"])
+def test_pipelined_worker_e2e(tmp_path, backend):
+    """columnar variant also covers pipeline x fused-coordinator-kernel
+    interplay (the fused calls run on the process thread while the
+    intake thread decodes)."""
     Config.set(PC.PIPELINE_WORKER, True)
     emu = PaxosEmulation(str(tmp_path), n_nodes=3, n_groups=64,
-                         backend="native")
+                         backend=backend)
     try:
-        stats = emu.run_load(500, concurrency=64, timeout=tscale(15))
-        assert stats["ok"] == 500, stats
+        # modest load: this asserts CORRECTNESS of the pipelined worker,
+        # not capacity — the columnar engine on a degraded shared box
+        # can dip to ~100 req/s, and 500 in-flight requests then blow
+        # any reasonable deadline with retransmit amplification
+        n = 500 if backend == "native" else 150
+        stats = emu.run_load(n, concurrency=32, timeout=tscale(20))
+        assert stats["ok"] == n, stats
         # three replicas converge on the same execution count
         deadline = time.time() + tscale(10)
         while time.time() < deadline:
