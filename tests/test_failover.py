@@ -203,7 +203,9 @@ def test_recovery_preserves_checkpoint_cut(tmp_path):
         node2.stop()
 
 
-def test_torture_loss_crash_churn(tmp_path):
+@pytest.mark.parametrize("pipeline", [False, True],
+                         ids=["plain", "pipelined"])
+def test_torture_loss_crash_churn(tmp_path, pipeline):
     """Everything at once (TESTPaxosConfig-style fault soup): sustained
     client load over 24 groups with 10% message loss on every link,
     one replica crash-stopped and later restarted over its WAL
@@ -212,7 +214,10 @@ def test_torture_loss_crash_churn(tmp_path):
     [client-confirmed, client-sent] at-most-once bounds and the
     CounterApp order-digests agree across ALL THREE replicas on every
     loaded group (the restarted one must catch up via WAL roll-forward
-    + gap sync)."""
+    + gap sync).  The ``pipelined`` variant runs the same soup on the
+    two-stage worker (PC.PIPELINE_WORKER) — crash-stop, restart, and
+    tick-driven failover must all survive the intake/process split."""
+    Config.set(PC.PIPELINE_WORKER, pipeline)
     Config.set(PC.PING_INTERVAL_S, 0.15)
     Config.set(PC.FAILURE_TIMEOUT_S, 1.0)
     # no deactivator: a slow run would pause idle groups mid-test and
