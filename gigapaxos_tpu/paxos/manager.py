@@ -291,6 +291,8 @@ class PaxosNode:
         self.intake_rps = float(Config.get(PC.MAX_INTAKE_RPS))
         self._intake_tokens = self.intake_rps
         self._intake_ts = time.time()
+        self.backlog_limit = int(Config.get(PC.INTAKE_BACKLOG_LIMIT))
+        self.n_shed = 0  # requests answered "retry" by the backlog guard
         if bool(Config.get(PC.TRACE_REQUESTS)):
             # only-enable: a manual RequestInstrumenter.enabled = True
             # (the documented runtime switch) must survive later node
@@ -1354,6 +1356,7 @@ class PaxosNode:
                 f"redrive={self.n_redriven}"
                 f"(capped={self.n_redrive_capped}) "
                 f"park={self.n_parked}(drop={self.n_park_dropped}) "
+                f"shed={self.n_shed} "
                 f"installs={self.n_installs} "
                 f"groups={len(self.table)} "
                 f"net[{self.transport.stats()}]")
@@ -1422,6 +1425,43 @@ class PaxosNode:
 
     def _handle_requests(self, reqs: List, props: List,
                          soas: Tuple = ()) -> None:
+        # congestion-collapse guard (PC.INTAKE_BACKLOG_LIMIT): a deep
+        # inbound backlog means the engine is past its knee.  Shed a
+        # PROPORTIONAL share of fresh client work (RED-style: ramps from
+        # 0 at limit/2 to 100% at limit) — all-or-nothing shedding
+        # oscillates (shed wave → synchronized client backoff →
+        # thundering herd), wasting the engine's duty cycle.  Shed lanes
+        # are answered status 1 so clients back off exponentially.  Peer
+        # traffic (props) always flows: it is work already admitted
+        # somewhere, and starving it deadlocks the pipeline.
+        if (reqs or soas) and self.backlog_limit > 0:
+            q = self._inq.qsize()
+            half = self.backlog_limit // 2
+            if q > half:
+                frac = min(1.0, (q - half) / max(1, half))
+                kept_soas = []
+                for sb in soas:
+                    n = len(sb.req_id)
+                    keep = n - int(n * frac)
+                    for i in range(keep, n):
+                        self._route(int(sb.sender[i]), pkt.Response(
+                            self.id, int(sb.gkey[i]),
+                            int(sb.req_id[i]), 1, b""))
+                    self.n_shed += n - keep
+                    if keep:
+                        kept_soas.append(_ReqSoA(
+                            sb.sender[:keep], sb.gkey[:keep],
+                            sb.req_id[:keep], sb.flags[:keep],
+                            sb.pay_off[:keep + 1], sb.pay))
+                soas = tuple(kept_soas)
+                keep = len(reqs) - int(len(reqs) * frac)
+                for o in reqs[keep:]:
+                    self._route(o.sender, pkt.Response(
+                        self.id, o.gkey, o.req_id, 1, b""))
+                self.n_shed += len(reqs) - keep
+                reqs = reqs[:keep]
+                if not (reqs or soas or props):
+                    return
         rows_parts: List[np.ndarray] = []
         req_parts: List[np.ndarray] = []
         flag_parts: List[int] = []

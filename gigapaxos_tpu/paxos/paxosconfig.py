@@ -73,6 +73,16 @@ class PC(ConfigKey):
     # beyond this many per second are answered status 1 ("retry") at the
     # door instead of admitted to the pipeline; 0 disables
     MAX_INTAKE_RPS = 0
+    # congestion-collapse guard (adaptive counterpart of the static rps
+    # limit): when the worker's inbound queue backs up past this many
+    # items, fresh client REQUESTs are answered status 1 ("retry") so
+    # clients back off exponentially instead of piling retransmits onto
+    # a saturated engine (observed: a closed-loop drive slightly past
+    # the columnar engine's knee collapsed 850 -> 190 req/s with
+    # timeouts; shedding keeps the engine at its knee).  Peer protocol
+    # traffic (proposals/accepts/replies/commits) always flows.  0
+    # disables.
+    INTAKE_BACKLOG_LIMIT = 2048
     # two-stage worker pipeline (SURVEY §7.1 host<->device overlap, the
     # PP analog): an intake thread collects + decodes batch k+1 while
     # the process thread runs batch k's backend call + WAL fsync + sends
