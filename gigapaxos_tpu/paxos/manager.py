@@ -293,6 +293,11 @@ class PaxosNode:
         self._intake_ts = time.time()
         self.backlog_limit = int(Config.get(PC.INTAKE_BACKLOG_LIMIT))
         self.n_shed = 0  # requests answered "retry" by the backlog guard
+        # backlog estimate in FRAMES: the queue holds chunk LISTS (one
+        # item can be a whole read chunk of thousands of frames), so
+        # qsize() alone wildly undercounts.  The worker extrapolates
+        # from the frames-per-item ratio of the batch it just collected.
+        self._backlog_est = 0
         if bool(Config.get(PC.TRACE_REQUESTS)):
             # only-enable: a manual RequestInstrumenter.enabled = True
             # (the documented runtime switch) must survive later node
@@ -902,6 +907,8 @@ class PaxosNode:
                 batch.append(nxt)
                 n_frames += len(nxt) if isinstance(nxt, list) else 1
             prev_items = n_frames
+            self._backlog_est = int(
+                self._inq.qsize() * n_frames / max(1, len(batch)))
             t0 = time.monotonic()
             c0 = self._ct()
             try:
@@ -987,6 +994,8 @@ class PaxosNode:
                     batch.append(nxt)
                     n_frames += len(nxt) if isinstance(nxt, list) else 1
                 prev_items = n_frames
+                self._backlog_est = int(
+                    self._inq.qsize() * n_frames / max(1, len(batch)))
                 t0 = time.monotonic()
                 try:
                     decoded = self._decode_batch(batch)
@@ -1435,7 +1444,7 @@ class PaxosNode:
         # traffic (props) always flows: it is work already admitted
         # somewhere, and starving it deadlocks the pipeline.
         if (reqs or soas) and self.backlog_limit > 0:
-            q = self._inq.qsize()
+            q = self._backlog_est
             half = self.backlog_limit // 2
             if q > half:
                 frac = min(1.0, (q - half) / max(1, half))
