@@ -415,18 +415,37 @@ def main():
     argv = [sys.executable, os.path.abspath(__file__), "--child"] + \
         sys.argv[1:]
     reason = None
+    # cheap bounded probe FIRST: a wedged tunnel would otherwise eat the
+    # whole primary watchdog budget before the fallback even starts
+    # (observed: 540s of a round's bench budget spent rediscovering a
+    # wedge the probe detects in seconds)
     try:
-        res = subprocess.run(argv, capture_output=True, timeout=budget)
-        line = res.stdout.decode().strip().splitlines()[-1] \
-            if res.stdout.strip() else ""
-        if res.returncode == 0 and line.startswith("{"):
-            _record_tpu_last_good(line)
-            print(line)
-            return 0
-        reason = f"primary run failed rc={res.returncode}"
-        sys.stderr.write(res.stderr.decode()[-2000:])
+        pr = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(jax.devices()[0].platform)"],
+            capture_output=True, timeout=90)
+        plat = (pr.stdout.decode().strip().splitlines()[-1]
+                if pr.returncode == 0 and pr.stdout.strip() else None)
+        if plat is None:
+            reason = "accelerator probe failed"
+        elif plat == "cpu":
+            reason = "no accelerator platform registered"
     except subprocess.TimeoutExpired:
-        reason = f"accelerator hung (> {budget}s)"
+        reason = "accelerator probe hung (> 90s)"
+    if reason is None:
+        try:
+            res = subprocess.run(argv, capture_output=True,
+                                 timeout=budget)
+            line = res.stdout.decode().strip().splitlines()[-1] \
+                if res.stdout.strip() else ""
+            if res.returncode == 0 and line.startswith("{"):
+                _record_tpu_last_good(line)
+                print(line)
+                return 0
+            reason = f"primary run failed rc={res.returncode}"
+            sys.stderr.write(res.stderr.decode()[-2000:])
+        except subprocess.TimeoutExpired:
+            reason = f"accelerator hung (> {budget}s)"
     try:
         res = subprocess.run(
             argv + ["--force-cpu"], capture_output=True, timeout=budget)
