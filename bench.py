@@ -34,6 +34,86 @@ import time
 
 import numpy as np
 
+BENCH_LOCK = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          ".gp_bench.lock")
+
+
+def probe_platform(timeout_s: int = 90):
+    """Bounded accelerator probe in a child process (a wedged tunnel
+    plugin can hang even backend init forever).  Returns the platform
+    string ("tpu"/"cpu"/...), or None on failure/hang.  The single
+    definition shared by the watchdog wrapper, run_full, and
+    tpu_watch.py — three hand-copies had already drifted their
+    timeouts (75/90/90s) by round 4."""
+    import subprocess
+    try:
+        res = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(jax.devices()[0].platform)"],
+            capture_output=True, timeout=timeout_s)
+        if res.returncode == 0 and res.stdout.strip():
+            return _last_json_line(res.stdout)
+        return None
+    except subprocess.TimeoutExpired:
+        return None
+
+
+class bench_lock:
+    """Best-effort one-bench-at-a-time lock around the measurement.
+    Serializes the watcher's auto-captures against manual bench runs —
+    both entry points go through main()/run_full, so acquiring here
+    covers both (the watcher-only lockfile of the first draft enforced
+    the invariant at the wrong layer).  Stale (>2h) locks are
+    reclaimed: a dead holder must not wedge benching for the round."""
+
+    def __enter__(self):
+        self.acquired = False
+        if os.environ.get("GP_BENCH_LOCK_HELD"):
+            return self  # reentrant: a parent bench already holds it
+        deadline = time.time() + 900  # wait out a live concurrent bench
+        while True:
+            try:
+                fd = os.open(BENCH_LOCK,
+                             os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+                os.write(fd, str(os.getpid()).encode())
+                os.close(fd)
+                self.acquired = True
+                return self
+            except FileExistsError:
+                try:
+                    stale = time.time() - os.path.getmtime(BENCH_LOCK) \
+                        > 7200
+                except OSError:
+                    continue  # holder just released; retry
+                if stale:
+                    try:
+                        os.unlink(BENCH_LOCK)
+                    except OSError:
+                        pass
+                    continue
+                if time.time() > deadline:
+                    sys.stderr.write(
+                        "bench: lock held >900s; proceeding anyway "
+                        "(measurements may contend for the chip)\n")
+                    return self  # acquired stays False: not ours to rm
+                time.sleep(5)
+
+    def __exit__(self, *exc):
+        if self.acquired:  # never unlink a lock some live holder owns
+            try:
+                os.unlink(BENCH_LOCK)
+            except OSError:
+                pass
+        return False
+
+
+def _last_json_line(stdout: bytes) -> str:
+    """The child's record is its LAST stdout line (warnings above it);
+    one definition — four hand-copies of this dance had grown in this
+    file, the same drift probe_platform was extracted to stop."""
+    s = stdout.decode().strip()
+    return s.splitlines()[-1] if s else ""
+
 
 def bench_columnar(G: int, W: int, B: int, iters: int, warmup: int,
                    trials: int):
@@ -300,25 +380,18 @@ def run_full(args) -> int:
     t_start = time.time()
     rows = {}
 
-    try:
-        res = subprocess.run(
-            [sys.executable, "-c",
-             "import jax; print(jax.devices()[0].platform)"],
-            capture_output=True, timeout=75)
-        platform = (res.stdout.decode().strip().splitlines()[-1]
-                    if res.returncode == 0 and res.stdout.strip()
-                    else None)
-    except subprocess.TimeoutExpired:
-        platform = None
+    platform = probe_platform(90)
     tpu_ok = platform not in (None, "cpu")
 
     def sub(key, argv, timeout, env=None):
         t0 = time.time()
+        # children (incl. the config3 bench.py re-entry) must not
+        # re-take the lock run_full already holds
+        env = dict(env or os.environ, GP_BENCH_LOCK_HELD="1")
         try:
             res = subprocess.run(argv, capture_output=True,
                                  timeout=timeout, env=env)
-            line = (res.stdout.decode().strip().splitlines()[-1]
-                    if res.stdout.strip() else "")
+            line = _last_json_line(res.stdout)
             if res.returncode == 0 and line.startswith("{"):
                 rows[key] = json.loads(line)
             else:
@@ -331,45 +404,48 @@ def run_full(args) -> int:
     here = os.path.abspath(__file__)
     m = [sys.executable, "-m", "gigapaxos_tpu.testing.main"]
     q = args.quick
-    storm_env = dict(os.environ,
-                     GP_BENCH_TIMEOUT_S="240" if q else "420",
-                     GP_BENCH_SKIP_E2E="1")
-    # probe already said wedged → don't spend the storm watchdog budget
-    # rediscovering it; go straight to the labeled host-XLA fallback
-    storm_extra = [] if tpu_ok else ["--force-cpu"]
-    sub("config3_storm_1m_groups",
-        [sys.executable, here] + (["--quick"] if q else []) + storm_extra,
-        600 if q else 900, env=storm_env)
-    if not tpu_ok and isinstance(rows.get("config3_storm_1m_groups"),
-                                 dict) and \
-            "metric" in rows["config3_storm_1m_groups"]:
-        rows["config3_storm_1m_groups"]["metric"] += \
-            " [FALLBACK on host XLA: accelerator probe wedged/absent]"
-    sub("config1_e2e_3r_1k_groups",
-        m + ["throughput", "--requests", "4000" if q else "20000"],
-        300 if q else 420)
-    col = ["throughput", "--backend", "columnar",
-           "--groups", "2000" if q else "100000",
-           "--capacity", str(1 << 12 if q else 1 << 17),
-           "--requests", "1000" if q else "4000",
-           "--concurrency", "448", "--pipeline"]
-    if tpu_ok:
-        col.append("--on-device")
-    sub("config2_columnar_100k_groups"
-        + ("_on_device" if tpu_ok else "_host_xla"),
-        m + col, 420 if q else 900)
-    sub("config4_churn_via_reconfigurator",
-        m + ["churn", "--via-reconfigurator",
-             "--requests", "2000" if q else "20000"],
-        300 if q else 600)
-    sub("config5_failover_5r",
-        m + ["failover", "--requests", "1000" if q else "5000"],
-        300 if q else 420)
-    sub("config5b_mass_takeover_100k",
-        m + ["failover", "--single-coordinator",
-             "--groups", "5000" if q else "100000",
-             "--requests", "1000"],
-        300 if q else 420)
+    with bench_lock():  # serialize the matrix vs watcher auto-captures
+        storm_env = dict(os.environ,
+                         GP_BENCH_TIMEOUT_S="240" if q else "420",
+                         GP_BENCH_SKIP_E2E="1")
+        # probe already said wedged → don't spend the storm watchdog
+        # budget rediscovering it; go straight to the labeled fallback
+        storm_extra = [] if tpu_ok else ["--force-cpu"]
+        sub("config3_storm_1m_groups",
+            [sys.executable, here] + (["--quick"] if q else [])
+            + storm_extra,
+            600 if q else 900, env=storm_env)
+        if not tpu_ok and isinstance(rows.get("config3_storm_1m_groups"),
+                                     dict) and \
+                "metric" in rows["config3_storm_1m_groups"]:
+            rows["config3_storm_1m_groups"]["metric"] += \
+                " [FALLBACK on host XLA: accelerator probe " \
+                "wedged/absent]"
+        sub("config1_e2e_3r_1k_groups",
+            m + ["throughput", "--requests", "4000" if q else "20000"],
+            300 if q else 420)
+        col = ["throughput", "--backend", "columnar",
+               "--groups", "2000" if q else "100000",
+               "--capacity", str(1 << 12 if q else 1 << 17),
+               "--requests", "1000" if q else "4000",
+               "--concurrency", "448", "--pipeline"]
+        if tpu_ok:
+            col.append("--on-device")
+        sub("config2_columnar_100k_groups"
+            + ("_on_device" if tpu_ok else "_host_xla"),
+            m + col, 420 if q else 900)
+        sub("config4_churn_via_reconfigurator",
+            m + ["churn", "--via-reconfigurator",
+                 "--requests", "2000" if q else "20000"],
+            300 if q else 600)
+        sub("config5_failover_5r",
+            m + ["failover", "--requests", "1000" if q else "5000"],
+            300 if q else 420)
+        sub("config5b_mass_takeover_100k",
+            m + ["failover", "--single-coordinator",
+                 "--groups", "5000" if q else "100000",
+                 "--requests", "1000"],
+            300 if q else 420)
 
     out = {
         "recorded_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
@@ -418,42 +494,38 @@ def main():
     # cheap bounded probe FIRST: a wedged tunnel would otherwise eat the
     # whole primary watchdog budget before the fallback even starts
     # (observed: 540s of a round's bench budget spent rediscovering a
-    # wedge the probe detects in seconds)
-    try:
-        pr = subprocess.run(
-            [sys.executable, "-c",
-             "import jax; print(jax.devices()[0].platform)"],
-            capture_output=True, timeout=90)
-        plat = (pr.stdout.decode().strip().splitlines()[-1]
-                if pr.returncode == 0 and pr.stdout.strip() else None)
+    # wedge the probe detects in seconds).  GP_BENCH_SKIP_PROBE: the
+    # caller (tpu_watch.py) just proved the accelerator healthy — don't
+    # pay a redundant 90s probe.
+    if not os.environ.get("GP_BENCH_SKIP_PROBE"):
+        plat = probe_platform(90)
         if plat is None:
-            reason = "accelerator probe failed"
+            reason = "accelerator probe failed or hung (> 90s)"
         elif plat == "cpu":
             reason = "no accelerator platform registered"
-    except subprocess.TimeoutExpired:
-        reason = "accelerator probe hung (> 90s)"
-    if reason is None:
+    with bench_lock():
+        if reason is None:
+            try:
+                res = subprocess.run(argv, capture_output=True,
+                                     timeout=budget)
+                line = _last_json_line(res.stdout)
+                if res.returncode == 0 and line.startswith("{"):
+                    _record_tpu_last_good(line)
+                    print(line)
+                    return 0
+                reason = f"primary run failed rc={res.returncode}"
+                sys.stderr.write(res.stderr.decode()[-2000:])
+            except subprocess.TimeoutExpired:
+                reason = f"accelerator hung (> {budget}s)"
         try:
-            res = subprocess.run(argv, capture_output=True,
-                                 timeout=budget)
-            line = res.stdout.decode().strip().splitlines()[-1] \
-                if res.stdout.strip() else ""
-            if res.returncode == 0 and line.startswith("{"):
-                _record_tpu_last_good(line)
-                print(line)
-                return 0
-            reason = f"primary run failed rc={res.returncode}"
-            sys.stderr.write(res.stderr.decode()[-2000:])
+            res = subprocess.run(
+                argv + ["--force-cpu"], capture_output=True,
+                timeout=budget)
         except subprocess.TimeoutExpired:
-            reason = f"accelerator hung (> {budget}s)"
-    try:
-        res = subprocess.run(
-            argv + ["--force-cpu"], capture_output=True, timeout=budget)
-    except subprocess.TimeoutExpired:
-        sys.stderr.write(f"bench: fallback also exceeded {budget}s\n")
-        return 1
-    line = res.stdout.decode().strip().splitlines()[-1] \
-        if res.stdout.strip() else ""
+            sys.stderr.write(
+                f"bench: fallback also exceeded {budget}s\n")
+            return 1
+    line = _last_json_line(res.stdout)
     if res.returncode == 0 and line.startswith("{"):
         out = json.loads(line)
         out["metric"] += f" [FALLBACK on host XLA: {reason}]"

@@ -1,0 +1,133 @@
+#!/usr/bin/env python
+"""Always-on accelerator watcher (round-4 verdict ask #1).
+
+The remote TPU tunnel on this host wedges for hours at a time; rounds 3
+and 4 ended with zero real-TPU artifacts because capture was passive
+(bench.py probes only when a bench is run).  This watcher makes the
+outage — and the recovery — a tracked artifact:
+
+- every ``--interval`` seconds, probe the accelerator in a bounded
+  subprocess (a wedged plugin can hang even backend init forever, so
+  the probe itself must be expendable);
+- append every probe outcome (timestamp, ok/wedged/absent, platform,
+  probe wall time) as one JSONL line to ``TPU_PROBE_LOG.jsonl``
+  (append-only like PROGRESS.jsonl: O(1) per tick, atomic enough via
+  O_APPEND, no read-modify-write lost updates);
+- on a healthy probe, if ``BENCH_TPU_LAST_GOOD.json`` is missing or
+  its ``recorded_at`` is older than ``--stale-hours``, immediately run
+  ``bench.py`` (which atomically records that file on any
+  real-accelerator run; its internal bench_lock serializes against
+  manual bench runs).
+
+Run it for a whole session::
+
+    python tpu_watch.py --interval 720 &
+
+If the tunnel never heals, the probe log IS the deliverable: a tracked
+timeline proving continuous outage instead of a README sentence.
+"""
+
+import argparse
+import calendar
+import json
+import os
+import subprocess
+import sys
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+LOG = os.path.join(HERE, "TPU_PROBE_LOG.jsonl")
+LAST_GOOD = os.path.join(HERE, "BENCH_TPU_LAST_GOOD.json")
+
+
+def probe(timeout_s: int = 90) -> dict:
+    from bench import probe_platform
+    t0 = time.time()
+    rec = {"ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())}
+    plat = probe_platform(timeout_s)
+    if plat is None:
+        rec["outcome"] = f"failed_or_wedged_gt_{timeout_s}s"
+    elif plat == "cpu":
+        rec["outcome"] = "no_accelerator"
+    else:
+        rec["outcome"] = "ok"
+        rec["platform"] = plat
+    rec["probe_wall_s"] = round(time.time() - t0, 1)
+    return rec
+
+
+def append_log(rec: dict) -> None:
+    with open(LOG, "a") as f:
+        f.write(json.dumps(rec) + "\n")
+
+
+def last_good_age_h() -> float:
+    """Hours since the artifact's embedded recorded_at (mtime lies
+    after a checkout/clone rewrites the file); mtime is the fallback
+    when the stamp is unparseable."""
+    try:
+        with open(LAST_GOOD) as f:
+            stamp = json.load(f).get("recorded_at", "")
+        t = calendar.timegm(
+            time.strptime(stamp, "%Y-%m-%dT%H:%M:%SZ"))
+        return (time.time() - t) / 3600.0
+    except (OSError, ValueError):
+        pass
+    try:
+        return (time.time() - os.path.getmtime(LAST_GOOD)) / 3600.0
+    except OSError:
+        return float("inf")
+
+
+def capture(bench_budget_s: int) -> dict:
+    """Run bench.py; it records BENCH_TPU_LAST_GOOD.json itself and
+    takes its own cross-process bench_lock.  Outer timeout covers the
+    worst case end to end — lock wait (900) + primary (budget) +
+    host-XLA fallback (budget) + slack — so we never SIGKILL bench.py
+    mid-flight and orphan its measurement grandchild."""
+    t0 = time.time()
+    env = dict(os.environ, GP_BENCH_TIMEOUT_S=str(bench_budget_s),
+               GP_BENCH_SKIP_PROBE="1")  # we just probed healthy
+    try:
+        res = subprocess.run(
+            [sys.executable, os.path.join(HERE, "bench.py")],
+            capture_output=True,
+            timeout=900 + 2 * bench_budget_s + 120, env=env)
+        return {"capture": "bench_rc_%d" % res.returncode,
+                "capture_wall_s": round(time.time() - t0, 1)}
+    except subprocess.TimeoutExpired:
+        return {"capture": "bench_timeout",
+                "capture_wall_s": round(time.time() - t0, 1)}
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--interval", type=int, default=720,
+                   help="seconds between probes (default 12 min)")
+    p.add_argument("--stale-hours", type=float, default=3.0,
+                   help="re-capture if BENCH_TPU_LAST_GOOD.json is "
+                        "older than this")
+    p.add_argument("--bench-budget", type=int, default=540)
+    p.add_argument("--once", action="store_true",
+                   help="one probe (+capture if due), then exit")
+    args = p.parse_args()
+    sys.path.insert(0, HERE)
+    while True:
+        # per-iteration guard: an always-on watcher that dies on one
+        # transient error (ENOSPC, a flaky probe import) is the exact
+        # passive-capture failure it exists to fix
+        try:
+            rec = probe()
+            if rec["outcome"] == "ok" and \
+                    last_good_age_h() > args.stale_hours:
+                rec.update(capture(args.bench_budget))
+            append_log(rec)
+        except Exception as exc:  # noqa: BLE001 - must stay alive
+            sys.stderr.write(f"tpu_watch: tick failed: {exc!r}\n")
+        if args.once:
+            return 0
+        time.sleep(args.interval)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
