@@ -131,6 +131,123 @@ class _Election:
     cursor: int = 0
 
 
+class _MassElections:
+    """SoA phase-1 bookkeeping for mass takeovers (the columnar analog
+    of a million `_Election` dict entries).  Round-5 measurement of the
+    1M-group takeover window: the per-lane dict path in the
+    prepare-reply merge cost ~2.3us x 4M reply lanes = 9.3s of an
+    18.9s blackout, and allocating 1M `_Election` objects another
+    ~2s — both replaced here by numpy over whole frames.
+
+    Only the idle-fleet common case lives here (empty accept window,
+    cursor caught up); rows that turn out to carry state are converted
+    to classic `_Election` objects on first sight and merge through
+    the unchanged per-row machinery."""
+
+    __slots__ = ("index", "rows", "bal", "started", "ackcnt",
+                 "ackmask", "quorum", "cursor", "n_live", "_bits")
+
+    def __init__(self, cap: int):
+        self.index = np.full(cap, -1, np.int32)  # row -> soa position
+        self.rows = np.empty(0, np.int64)
+        self.bal = np.empty(0, np.int32)
+        self.started = np.empty(0, np.float64)
+        self.ackcnt = np.empty(0, np.int16)
+        self.ackmask = np.empty(0, np.uint64)
+        self.quorum = np.empty(0, np.int16)
+        self.cursor = np.empty(0, np.int32)
+        self.n_live = 0
+        self._bits: Dict[int, int] = {}  # sender id -> ackmask bit
+
+    def bit(self, sender: int) -> Optional[np.uint64]:
+        b = self._bits.get(sender)
+        if b is None:
+            if len(self._bits) >= 64:
+                return None  # caller degrades those lanes to dict path
+            b = len(self._bits)
+            self._bits[sender] = b
+        return np.uint64(1 << b)
+
+    def _live_positions(self) -> np.ndarray:
+        pos = np.arange(len(self.rows))
+        return pos[self.index[self.rows] == pos]
+
+    def _compact(self) -> None:
+        keep = self._live_positions()
+        for f in ("rows", "bal", "started", "ackcnt", "ackmask",
+                  "quorum", "cursor"):
+            setattr(self, f, getattr(self, f)[keep])
+        self.index[self.rows] = np.arange(len(self.rows),
+                                          dtype=np.int32)
+
+    def start(self, rows: np.ndarray, bals: np.ndarray, quorum: int,
+              now: float) -> None:
+        """Open (or re-drive) elections for ``rows`` under ``bals``.
+        Re-driven rows keep their slot with counters reset — the same
+        replace semantics as the dict path's `_Election` overwrite."""
+        if len(self.rows) > 4 * max(self.n_live, 1 << 14):
+            self._compact()  # bound growth across repeated cohorts
+        rows = np.asarray(rows, np.int64)
+        bals = np.asarray(bals, np.int32)
+        idx = self.index[rows]
+        upd = idx >= 0
+        if upd.any():
+            iu = idx[upd]
+            self.bal[iu] = bals[upd]
+            self.started[iu] = now
+            self.ackcnt[iu] = 0
+            self.ackmask[iu] = 0
+            self.cursor[iu] = 0
+        fresh = ~upd
+        if fresh.any():
+            rf = rows[fresh]
+            base = len(self.rows)
+            self.index[rf] = np.arange(base, base + len(rf),
+                                       dtype=np.int32)
+            n = len(rf)
+            self.rows = np.concatenate([self.rows, rf])
+            self.bal = np.concatenate([self.bal, bals[fresh]])
+            self.started = np.concatenate(
+                [self.started, np.full(n, now)])
+            self.ackcnt = np.concatenate(
+                [self.ackcnt, np.zeros(n, np.int16)])
+            self.ackmask = np.concatenate(
+                [self.ackmask, np.zeros(n, np.uint64)])
+            self.quorum = np.concatenate(
+                [self.quorum, np.full(n, quorum, np.int16)])
+            self.cursor = np.concatenate(
+                [self.cursor, np.zeros(n, np.int32)])
+            self.n_live += n
+
+    def has(self, row: int) -> bool:
+        return self.n_live > 0 and self.index[row] >= 0
+
+    def kill(self, rows: np.ndarray) -> None:
+        """Close elections for ``rows`` (all currently live)."""
+        if len(rows):
+            self.index[np.asarray(rows, np.int64)] = -1
+            self.n_live -= len(rows)
+
+    def pop(self, row: int):
+        """Remove ``row``; returns (bal, started, cursor, acks set) or
+        None — the fields a classic `_Election` needs."""
+        i = int(self.index[row])
+        if i < 0:
+            return None
+        self.index[row] = -1
+        self.n_live -= 1
+        mask = int(self.ackmask[i])
+        acks = {s for s, b in self._bits.items() if (mask >> b) & 1}
+        return (int(self.bal[i]), float(self.started[i]),
+                int(self.cursor[i]), acks)
+
+    def stale_rows(self, now: float, backoff: float) -> np.ndarray:
+        if not self.n_live:
+            return np.empty(0, np.int64)
+        pos = self._live_positions()
+        return self.rows[pos[now - self.started[pos] >= backoff]]
+
+
 class PaxosNode:
     """One replica node (server)."""
 
@@ -274,6 +391,7 @@ class PaxosNode:
         self._resp_cache: Dict[int, Tuple[int, bytes]] = {}
         self._resp_cache_old: Dict[int, Tuple[int, bytes]] = {}
         self._elections: Dict[int, _Election] = {}
+        self._mass_el: Optional[_MassElections] = None  # lazy (SoA)
 
         # deactivator (ref: DiskMap pause/unpause + HotRestoreInfo):
         # idle groups are serialized to the durable pause table and their
@@ -527,6 +645,8 @@ class PaxosNode:
             self.table.delete(meta.gkey)
             self._reset_row(meta.row)
             self._elections.pop(meta.row, None)
+            if self._mass_el is not None:
+                self._mass_el.pop(meta.row)
             self._group_stopped.discard(meta.row)
         self.logger.delete_groups([m.gkey for m in metas])
         for meta in metas:
@@ -604,7 +724,8 @@ class PaxosNode:
             if meta is None:
                 self._la[row] = np.inf
                 continue
-            if (row in self._elections or self._dec.get(row)
+            if (row in self._elections or self._mass_has(row)
+                    or self._dec.get(row)
                     or row in self._group_stopped
                     or row in inflight_rows
                     or self._parked.get(row)):
@@ -1078,6 +1199,31 @@ class PaxosNode:
             else:
                 for row in stalled:
                     self._start_election(row, self.table.by_row(row))
+        if self._mass_el is not None and self._mass_el.n_live:
+            # same liveness invariant for the SoA cohort ("one lost
+            # Prepare or PrepareReply must never wedge a group") — and
+            # it must not depend on the victim still being a suspect
+            # (a rejoining victim clears suspicion, which stops the
+            # rescan re-drive below).  Backoff scales with cohort size:
+            # re-driving a million mid-merge elections at a fixed 2s
+            # would reset ack counts while replies are still arriving.
+            backoff = max(2.0, self._mass_el.n_live / 2e5)
+            rows_st = self._mass_el.stale_rows(now, backoff)
+            if len(rows_st):
+                by_mems2: Dict[Tuple[int, ...], List[int]] = {}
+                by_row = self.table._by_row
+                dead_rows = []
+                for row in rows_st.tolist():
+                    meta = by_row[row]
+                    if meta is None:
+                        dead_rows.append(row)
+                    else:
+                        by_mems2.setdefault(meta.members,
+                                            []).append(row)
+                if dead_rows:
+                    self._mass_el.kill(np.asarray(dead_rows, np.int64))
+                if by_mems2:
+                    self._start_elections_batch(by_mems2, now)
         if self._suspects:
             # vectorized rescan (was a Python loop over every meta per
             # tick — minutes at 1M groups); rows with an election fresher
@@ -1150,7 +1296,8 @@ class PaxosNode:
                     self._parked.pop(row, None)
                     continue
                 coord = unpack_ballot(int(self._bal[row]))[1]
-                if row not in self._elections and coord >= 0 and \
+                if row not in self._elections and \
+                        not self._mass_has(row) and coord >= 0 and \
                         coord not in self._suspects and \
                         row not in self._catchup_barrier:
                     self._flush_parked(row)
@@ -1569,7 +1716,8 @@ class PaxosNode:
             if coord != self.id:
                 prop = pkt.Proposal(
                     self.id, o.gkey, o.req_id, o.sender, o.flags, o.payload)
-                if (meta.row in self._elections or coord < 0
+                if (meta.row in self._elections
+                        or self._mass_has(meta.row) or coord < 0
                         or coord in self._suspects):
                     # leadership unsettled: park instead of forwarding to
                     # a dead/unknown coordinator (the old behavior black-
@@ -1618,7 +1766,8 @@ class PaxosNode:
                 # unsettled; otherwise bounce onward AT MOST once per
                 # window (the second sighting parks — breaks forward
                 # cycles between stale views without a wire TTL)
-                if (meta.row in self._elections or coord < 0
+                if (meta.row in self._elections
+                        or self._mass_has(meta.row) or coord < 0
                         or coord in self._suspects):
                     self._park(meta.row, o)
                 elif coord == o.sender:
@@ -2351,6 +2500,21 @@ class PaxosNode:
                               & ((self._bal & NODE_MASK) == dead))
         if not len(cand):
             return
+        if self._mass_el is not None and self._mass_el.n_live:
+            # skip rows whose SoA-cohort election is fresher than the
+            # re-drive backoff (the dict check below can't see them;
+            # without this the per-tick suspect rescan would restart
+            # the whole cohort every tick).  The backoff scales with
+            # cohort size: re-driving a million in-flight elections at
+            # a fixed 2s would reset ack counts mid-merge.
+            m = self._mass_el
+            backoff = max(2.0, m.n_live / 2e5)
+            idx = m.index[cand]
+            fresh = (idx >= 0) & (now - m.started[np.maximum(idx, 0)]
+                                  < backoff)
+            cand = cand[~fresh]
+            if not len(cand):
+                return
         by_row = self.table._by_row
         nxt_cache: Dict[Tuple[int, ...], Optional[int]] = {}
         by_mems: Dict[Tuple[int, ...], List[int]] = {}
@@ -2405,15 +2569,40 @@ class PaxosNode:
                 return cand
         return None
 
+    @property
+    def open_elections(self) -> int:
+        """Elections in flight on this node (dict + mass-SoA paths)."""
+        return len(self._elections) + \
+            (self._mass_el.n_live if self._mass_el is not None else 0)
+
+    def _mass_has(self, row: int) -> bool:
+        return self._mass_el is not None and self._mass_el.has(row)
+
+    def _mass_to_dict(self, row: int) -> Optional[_Election]:
+        """Move a row's election from the SoA cohort to a classic
+        `_Election` (rows that turn out to need per-row merge state)."""
+        got = self._mass_el.pop(row) if self._mass_el is not None \
+            else None
+        if got is None:
+            return None
+        bal, started, cursor, acks = got
+        el = _Election(bal, started)
+        el.acks = acks or None
+        el.cursor = cursor
+        self._elections[row] = el
+        return el
+
     def _start_elections_batch(self, by_mems: Dict[Tuple[int, ...],
                                                    List[int]],
                                now: float) -> None:
         """Batched phase-1 kickoff: one ``PrepareBatch`` frame per member
-        per 64K rows instead of one Prepare frame per (row, member).
+        per 64K rows instead of one Prepare frame per (row, member), and
+        SoA cohort bookkeeping instead of one `_Election` per row.
         Takes rows pre-grouped by (interned) member set — the scan that
         found them already knows it."""
         t0 = time.monotonic()
-        els = self._elections
+        if self._mass_el is None:
+            self._mass_el = _MassElections(len(self._bal))
         total = 0
         CH = 1 << 16
         for mems, rows_list in by_mems.items():
@@ -2423,8 +2612,16 @@ class PaxosNode:
             new_bals = ((nums + 1) << NODE_BITS
                         | self.id).astype(np.int32)
             gkeys = self._row_gkey[arr]
-            for row, nb in zip(rows_list, new_bals.tolist()):
-                els[row] = _Election(nb, now)
+            # a row re-driven out of the dict path must not be tracked
+            # twice (dict wins the reply merge; the SoA entry would
+            # rot).  Intersect from the SMALL side: dict elections are
+            # few, the cohort can be a million rows.
+            if self._elections:
+                rowset = set(rows_list)
+                for row in [r for r in self._elections if r in rowset]:
+                    self._elections.pop(row, None)
+            self._mass_el.start(arr, new_bals,
+                                len(mems) // 2 + 1, now)
             total += len(rows_list)
             for at in range(0, len(arr), CH):
                 fg = np.ascontiguousarray(gkeys[at:at + CH])
@@ -2448,6 +2645,8 @@ class PaxosNode:
     def _start_election(self, row: int, meta) -> None:
         num, _ = unpack_ballot(int(self._bal[row]))
         el = self._elections.get(row)
+        if el is None and self._mass_has(row):
+            el = self._mass_to_dict(row)  # single path takes over
         if el is not None and time.time() - el.started < 2.0:
             return
         bal = pack_ballot(num + 1, self.id)
@@ -2546,9 +2745,16 @@ class PaxosNode:
         rows = self.table.rows_for_keys(gkeys).astype(np.int64)
         counts = np.asarray(o.counts)
         offs = np.concatenate([[0], np.cumsum(counts)]).astype(np.int64)
+        lanes = range(len(rows))
+        if self._mass_el is not None and self._mass_el.n_live:
+            handled = self._mass_reply_frame(o, rows, counts)
+            if handled is not None:
+                lanes = np.flatnonzero(~handled).tolist()
+                if not lanes:
+                    return
         install_rows: List[int] = []
         by_row = self.table._by_row
-        for i in range(len(rows)):
+        for i in lanes:
             row = int(rows[i])
             meta = by_row[row] if row >= 0 else None
             if meta is None:
@@ -2601,15 +2807,93 @@ class PaxosNode:
         if simple:
             self._install_simple_batch(simple)
 
+    def _mass_reply_frame(self, o, rows: np.ndarray,
+                          counts: np.ndarray) -> Optional[np.ndarray]:
+        """Vectorized prepare-reply merge against the SoA cohort.
+        Returns a bool mask of lanes fully consumed here (None = no
+        lane touched the cohort); unconsumed lanes — rows on the dict
+        path, or converted to it because they carry window state —
+        fall through to the per-row machinery."""
+        mass = self._mass_el
+        idx = np.where(rows >= 0, mass.index[np.maximum(rows, 0)], -1)
+        in_mass = idx >= 0
+        if not in_mass.any():
+            return None
+        handled = np.zeros(len(rows), bool)
+        bals = np.asarray(o.bal, np.int32)
+        acked = np.asarray(o.acked, bool)
+        cursors = np.asarray(o.cursor, np.int32)
+        idx0 = np.maximum(idx, 0)
+        # nacks: a higher ballot kills the election (same as the dict
+        # path); a stale/equal nack is ignored — both lanes consumed
+        nack = in_mass & ~acked
+        if nack.any():
+            hi = nack & (bals > mass.bal[idx0])
+            if hi.any():
+                r = rows[hi]
+                np.maximum.at(self._bal, r, bals[hi])
+                mass.kill(r)
+            handled |= nack
+        match = in_mass & acked & (bals == mass.bal[idx0])
+        handled |= in_mass & acked & ~match  # stale-ballot ack: ignore
+        # rows carrying accept-window state need the per-row merge:
+        # convert and leave the lane unconsumed for the dict loop
+        windowed = match & (counts > 0)
+        if windowed.any():
+            for i in np.flatnonzero(windowed).tolist():
+                self._mass_to_dict(int(rows[i]))
+            match &= ~windowed
+        if not match.any():
+            return handled
+        sb = mass.bit(o.sender)
+        if sb is None:  # >64 distinct senders: degrade to dict path
+            for i in np.flatnonzero(match).tolist():
+                self._mass_to_dict(int(rows[i]))
+            return handled
+        iv = idx[match]  # unique: one lane per gkey per frame
+        prev = mass.ackmask[iv]
+        newly = (prev & sb) == 0
+        ivn = iv[newly]
+        mass.ackmask[ivn] = prev[newly] | sb
+        mass.ackcnt[ivn] += 1
+        np.maximum.at(mass.cursor, iv, cursors[match])
+        handled |= match
+        ready = mass.ackcnt[iv] >= mass.quorum[iv]
+        if ready.any():
+            r_rows = rows[match][ready]
+            r_idx = iv[ready]
+            r_bals = mass.bal[r_idx].copy()
+            behind = mass.cursor[r_idx] > self._cur[r_rows]
+            if behind.any():
+                # cursor catch-up needs the classic install (decide
+                # sync); quorum is already met, so install directly
+                by_row = self.table._by_row
+                for row in r_rows[behind].tolist():
+                    el = self._mass_to_dict(row)
+                    meta = by_row[row]
+                    if el is not None and meta is not None:
+                        self._install_as_coordinator(
+                            row, meta, self._elections.pop(row))
+            simple = r_rows[~behind]
+            if len(simple):
+                mass.kill(simple)
+                self._install_simple_rows(simple, r_bals[~behind])
+        return handled
+
     def _install_simple_batch(self, rows: List[int]) -> None:
         """Batched coordinator install for idle rows: empty carryover,
-        cursor caught up — the mass-takeover common case."""
-        t0 = time.monotonic()
-        n = len(rows)
-        W = self.backend.window
-        arr = np.asarray(rows, np.int64)
+        cursor caught up — the mass-takeover common case (dict-path
+        entry; the SoA path calls ``_install_simple_rows`` directly)."""
         els = [self._elections.pop(r) for r in rows]
-        bals = np.asarray([el.bal for el in els], np.int32)
+        self._install_simple_rows(
+            np.asarray(rows, np.int64),
+            np.asarray([el.bal for el in els], np.int32))
+
+    def _install_simple_rows(self, arr: np.ndarray,
+                             bals: np.ndarray) -> None:
+        t0 = time.monotonic()
+        n = len(arr)
+        W = self.backend.window
         next_slots = self._cur[arr].astype(np.int32)
         self.backend.install_coordinator(
             arr.astype(np.int32), bals, next_slots,
@@ -2621,8 +2905,9 @@ class PaxosNode:
         # one of ours for these rows is an orphan — re-propose fresh
         # under the new regime (invert ONCE, not a _proposed scan per row)
         reprops: List = []
+        rowset = None
         if self._proposed:
-            rowset = set(rows)
+            rowset = set(arr.tolist())
             for rid, fl in [(r, f) for r, f in self._proposed.items()
                             if f.row in rowset]:
                 self._proposed.pop(rid, None)
@@ -2633,8 +2918,13 @@ class PaxosNode:
                         reprops.append(pkt.Proposal(
                             self.id, meta.gkey, rid, self.id, got[0],
                             got[1]))
-        for row in rows:
-            self._flush_parked(row)
+        if self._parked:
+            # intersect from the SMALL side: parked rows are few, the
+            # install batch can be a million rows
+            if rowset is None:
+                rowset = set(arr.tolist())
+            for row in [r for r in self._parked if r in rowset]:
+                self._flush_parked(row)
         if reprops:
             self._handle_requests([], reprops)
         DelayProfiler.update_total("fo.install", t0, n)
@@ -2647,6 +2937,10 @@ class PaxosNode:
             return
         row = meta.row
         el = self._elections.get(row)
+        if el is None and self._mass_has(row):
+            # a singleton reply can land for a SoA-cohort row (e.g. a
+            # retransmit after a re-drive): move it to the dict path
+            el = self._mass_to_dict(row)
         if el is None:
             return
         if not o.acked:

@@ -38,7 +38,8 @@ def _totals_delta(before: dict, after: dict) -> dict:
     debug dump)."""
     out = {}
     for tag, t in after.items():
-        if not (tag.startswith("w.") or tag.startswith("node.")):
+        if not (tag.startswith("w.") or tag.startswith("node.")
+                or tag.startswith("fo.")):
             continue
         b = before.get(tag, (0.0, 0, 0, 0.0))
         d = (t[0] - b[0], t[1] - b[1], t[2] - b[2], t[3] - b[3])
@@ -351,6 +352,8 @@ def churn_via_reconfigurator(args) -> dict:
             await cli.close()
             return made, gone, wall
 
+        from gigapaxos_tpu.utils.profiler import DelayProfiler
+        totals_before = DelayProfiler.totals()
         made, gone, wall = asyncio.run(body())
         assert made == n // 2, f"creates lost: {made}/{n // 2}"
         assert gone == n // 2, f"deletes lost: {gone}/{n // 2}"
@@ -360,7 +363,12 @@ def churn_via_reconfigurator(args) -> dict:
                       f"reconfiguration control plane, {n_active} actives"
                       f" + {n_rc} RCs (epoch FSM, {args.backend})",
             "value": round(ops / wall, 1), "unit": "ops/s",
-            "info": {"ops": ops, "wall_s": round(wall, 3)},
+            "info": {"ops": ops, "wall_s": round(wall, 3),
+                     # where the control-plane budget goes (round-4
+                     # verdict Weak #2): w.upper.* = per-packet-type
+                     # epoch-FSM handler totals across all 6 nodes
+                     "stage_totals": _totals_delta(
+                         totals_before, DelayProfiler.totals())},
         }
     finally:
         for nd in nodes:
@@ -556,6 +564,8 @@ def failover_mass(args) -> dict:
         base_installs = node.n_installs
         target = int(np.sum((node._bal >= 0)
                             & ((node._bal & NODE_MASK) == victim)))
+        from gigapaxos_tpu.utils.profiler import DelayProfiler
+        totals_before = DelayProfiler.totals()
         emu.kill(victim)
         t0 = time.perf_counter()
         # drive load THROUGH the takeover window in a side thread
@@ -576,7 +586,7 @@ def failover_mass(args) -> dict:
         deadline = time.time() + 300
         while time.time() < deadline and (
                 node.n_installs - base_installs < target
-                or node._elections):
+                or node.open_elections):
             time.sleep(0.25)
         t_takeover = time.perf_counter() - t0
         installed = node.n_installs - base_installs
@@ -599,6 +609,12 @@ def failover_mass(args) -> dict:
                 if t_takeover else None,
                 "pre": pre, "post_through_failover": post,
                 "victim": victim, "successor": successor,
+                # where the takeover window went: fo.scan (dead-
+                # coordinator sweep), fo.elect_start (election kickoff),
+                # fo.install (coordinator install), w.prepare_batch /
+                # w.prepare_reply_batch (the batched wire forms), WAL
+                "stage_totals": _totals_delta(
+                    totals_before, DelayProfiler.totals()),
             },
         }
     finally:

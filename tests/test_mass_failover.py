@@ -89,11 +89,11 @@ def test_mass_takeover_batched(tmp_path, backend):
         # .jax_cache) can land mid-takeover and stall the worker ~10s+
         deadline = time.time() + tscale(45)
         while time.time() < deadline and (
-                node.n_installs < n_groups or node._elections):
+                node.n_installs < n_groups or node.open_elections):
             time.sleep(0.1)
         assert node.n_installs >= n_groups, (
             f"only {node.n_installs}/{n_groups} groups taken over "
-            f"(elections left: {len(node._elections)})")
+            f"(elections left: {node.open_elections})")
         # liveness through the new regime: every request decided
         post = emu.run_load(60, concurrency=16, timeout=tscale(15),
                             client_id=1 << 21)
@@ -103,5 +103,53 @@ def test_mass_takeover_batched(tmp_path, backend):
         row = node.table.by_name(names[0]).row
         num, coord = unpack_ballot(int(node._bal[row]))
         assert coord == successor and num >= 1
+    finally:
+        emu.stop()
+
+
+def test_mass_takeover_redrives_lost_wave(tmp_path):
+    """Liveness invariant on the SoA cohort path ("one lost Prepare or
+    PrepareReply must never wedge a group"): the successor's FIRST
+    prepare wave is entirely lost (outbound drop=1.0 at the moment of
+    the kill), and suspicion alone cannot be relied on to retry — the
+    stalled-election re-drive in _tick must re-send the PrepareBatch
+    wave after the backoff and complete the takeover."""
+    victim = 0
+    names = []
+    i = 0
+    while len(names) < 128:  # past the >=64 batch threshold
+        nm = f"rd{i}"
+        i += 1
+        if group_key(nm) % 3 == victim:
+            names.append(nm)
+    emu = PaxosEmulation(str(tmp_path), n_nodes=3, n_groups=0,
+                         group_size=3, backend="native",
+                         capacity=1024, ping_interval_s=0.15,
+                         failure_timeout_s=1.0)
+    try:
+        emu.create_groups(len(names), names=names)
+        pre = emu.run_load(30, concurrency=8, timeout=tscale(10))
+        assert pre["ok"] == 30
+        time.sleep(0.5)
+        successor = (victim + 1) % 3
+        node = emu.nodes[successor]
+        node.transport.test_drop_rate = 1.0  # eat the first wave
+        emu.kill(victim)
+        # wait until the cohort is open (the wave was sent and lost)
+        deadline = time.time() + tscale(15)
+        while time.time() < deadline and not node.open_elections:
+            time.sleep(0.05)
+        assert node.open_elections, "election never started"
+        node.transport.test_drop_rate = 0.0
+        deadline = time.time() + tscale(20)
+        while time.time() < deadline and (
+                node.n_installs < len(names) or node.open_elections):
+            time.sleep(0.1)
+        assert node.n_installs >= len(names), (
+            f"re-drive never completed: {node.n_installs}/{len(names)} "
+            f"installed, {node.open_elections} elections open")
+        post = emu.run_load(30, concurrency=8, timeout=tscale(15),
+                            client_id=1 << 21)
+        assert post["ok"] == 30, f"post-takeover load failed: {post}"
     finally:
         emu.stop()
