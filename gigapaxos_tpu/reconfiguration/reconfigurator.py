@@ -150,6 +150,18 @@ class Reconfigurator:
     # -- client/active control traffic (worker thread) ---------------------
 
     def _on_control(self, o: pkt.Control) -> None:
+        import time as _time
+
+        from gigapaxos_tpu.utils.profiler import DelayProfiler
+        _t0 = _time.monotonic()
+        _c0 = _time.thread_time()
+        try:
+            self._on_control_inner(o)
+        finally:
+            DelayProfiler.update_total(
+                f"w.rc.{o.body.get('rc')}", _t0, cpu_t0=_c0)
+
+    def _on_control_inner(self, o: pkt.Control) -> None:
         b = o.body
         t = b.get("rc")
         if t in (rc.CREATE_NAME, rc.DELETE_NAME, rc.REQ_ACTIVES,

@@ -297,6 +297,7 @@ def churn_via_reconfigurator(args) -> dict:
     StartEpoch batch -> majority AckStart -> READY; deletes through
     WAIT_ACK_STOP -> paxos stop decisions -> dropped)."""
     import asyncio
+    import os
     import socket
 
     from gigapaxos_tpu.paxos.interfaces import NoopApp
@@ -329,8 +330,8 @@ def churn_via_reconfigurator(args) -> dict:
         nd.start()
     try:
         n = args.requests
-        chunk = 2048
-        inflight = 4  # batches pipelined per phase
+        chunk = int(os.environ.get("GP_CHURN_CHUNK", "2048"))
+        inflight = int(os.environ.get("GP_CHURN_INFLIGHT", "4"))
 
         async def phase(cli, names, op):
             done = 0

@@ -1,0 +1,234 @@
+#!/usr/bin/env python
+"""Render README's measured-performance table from the tracked bench
+artifacts (round-4 verdict ask #7: the numbers lived in three places —
+README, BASELINE.md, BENCH_FULL.json — with no generation link, and
+hand-maintained tables rot).
+
+Source of truth:
+- ``BENCH_FULL.json``        (python bench.py --full)
+- ``BENCH_TPU_LAST_GOOD.json`` (auto-recorded by any real-TPU bench run)
+
+Usage::
+
+    python render_perf.py          # print the table block
+    python render_perf.py --write  # splice it into README.md between
+                                   # the GENERATED PERF markers
+
+``tests/test_readme_perf.py`` renders and diffs against README, so a
+stale table fails the suite instead of shipping.
+"""
+
+import argparse
+import json
+import os
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+BEGIN = "<!-- BEGIN GENERATED PERF (render_perf.py; do not hand-edit) -->"
+END = "<!-- END GENERATED PERF -->"
+
+
+def _load(name):
+    try:
+        with open(os.path.join(HERE, name)) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def _fmt_k(v):
+    if v is None:
+        return "?"
+    if v >= 1e6:
+        return f"{v / 1e6:.2f}M"
+    if v >= 1e4:
+        return f"{v / 1e3:.1f}K"
+    return f"{v:,.0f}" if v >= 100 else f"{v:g}"
+
+
+def render() -> str:
+    full = _load("BENCH_FULL.json") or {}
+    tpu = _load("BENCH_TPU_LAST_GOOD.json")
+    rows = full.get("rows", {})
+    out = [BEGIN]
+    out.append("")
+    stamp = full.get("recorded_at", "?")
+    out.append(f"Generated from `BENCH_FULL.json` (recorded {stamp}, "
+               f"accelerator probe: {full.get('accelerator_probe', '?')}"
+               f", {full.get('host_cpus', '?')} host core(s)) and "
+               "`BENCH_TPU_LAST_GOOD.json`. Regenerate: "
+               "`python render_perf.py --write`.")
+    out.append("")
+    out.append("| Benchmark | Result |")
+    out.append("|---|---|")
+
+    if tpu:
+        i = tpu.get("info", {})
+        out.append(
+            "| Decisions/sec, 1M groups, 256K-lane accept storms on the "
+            f"REAL TPU (`bench.py`, platform={i.get('platform')}) | "
+            f"**{_fmt_k(tpu.get('value'))}/s** median "
+            f"({tpu.get('trials')} trials, spread "
+            f"{tpu.get('spread')}), **{tpu.get('vs_baseline')}×** the "
+            "C++ per-instance host engine "
+            f"({_fmt_k(i.get('native_baseline_dps'))}/s); step p99 "
+            f"{tpu.get('p99_ms')} ms at 256K lanes/step; recorded "
+            f"{tpu.get('recorded_at')} |")
+    else:
+        out.append("| Decisions/sec on the REAL TPU | no healthy-"
+                   "accelerator artifact yet (`BENCH_TPU_LAST_GOOD."
+                   "json` missing; see `TPU_PROBE_LOG.jsonl`) |")
+
+    def row(key):
+        r = rows.get(key)
+        return r if isinstance(r, dict) and "value" in r else None
+
+    r = row("config3_storm_1m_groups")
+    if r:
+        i = r["info"]
+        out.append(
+            f"| Storm bench in this matrix run (config 3: "
+            f"{_fmt_k(i.get('groups'))} groups) | "
+            f"{_fmt_k(r['value'])}/s, {r.get('vs_baseline')}× the C++ "
+            f"engine — platform {i.get('platform')}"
+            + (" (labeled host-XLA fallback)"
+               if "FALLBACK" in r.get("metric", "") else "")
+            + f"; e2e latency point p50 {r.get('e2e_req_p50_ms')} ms / "
+              f"p99 {r.get('e2e_req_p99_ms')} ms |")
+
+    r = row("config1_e2e_3r_1k_groups")
+    if r:
+        lp = r["info"].get("latency_point", {})
+        out.append(
+            "| E2E decided req/s, 3 replicas, 1K groups, real loopback "
+            "sockets (config 1, native engine) | "
+            f"**{_fmt_k(r['value'])} req/s** at depth 2048; latency "
+            f"point: {_fmt_k(lp.get('throughput_rps'))} req/s, p50 "
+            f"{lp.get('lat_p50_ms')} ms / p99 {lp.get('lat_p99_ms')} ms "
+            "at depth 32 — one core shared by 3 nodes + client |")
+
+    r = row("config2_columnar_100k_groups_host_xla_knee")
+    if r:
+        i = r["info"]
+        out.append(
+            "| Columnar served path, 100K groups (config 2, host XLA, "
+            "pipelined) | "
+            f"**{_fmt_k(r['value'])} req/s at the swept knee** (depth "
+            f"{i.get('knee_depth')}, p99 {i.get('lat_p99_ms')} ms ≤ "
+            f"{i.get('p99_bound_ms', 500)} ms bound); the artifact "
+            "records the operating point, not the deepest closed loop "
+            "(round-4 row was a congestion collapse: 227 req/s, p99 "
+            "8.8 s); stage budget in `info.stage_totals` |")
+
+    r = row("config2_columnar_on_device")
+    if r:
+        i = r["info"]
+        out.append(
+            "| Columnar served path ON the real TPU (config 2b) | "
+            f"{_fmt_k(r['value'])} req/s at depth 128 — every engine "
+            "call crosses the WAN tunnel (measured "
+            f"{i.get('device_dispatch_rtt_ms')} ms per device call vs "
+            "~0.1 ms locally attached), which is the measured rationale "
+            "for the host-XLA default on the served path |")
+
+    r = row("config4_churn_via_reconfigurator")
+    if r:
+        st = r["info"].get("stage_totals", {})
+        cpu = sum(v.get("cpu_s", 0) for k, v in st.items()
+                  if k in ("w.commits", "w.decode", "w.requests",
+                           "w.accepts", "w.replies")) + \
+            sum(v.get("cpu_s", 0) for k, v in st.items()
+                if k.startswith(("w.rc.", "w.ar.")))
+        ops = r["info"].get("ops", 0)
+        ceil = f"; measured CPU ≈ {1e6 * cpu / ops:.0f} µs/op across " \
+               "the multi-hop FSM → one-core ceiling ≈ " \
+               f"{_fmt_k(ops / cpu if cpu else None)} ops/s" \
+            if cpu and ops else ""
+        out.append(
+            "| Group churn through the reconfiguration control plane "
+            "(config 4, epoch FSM) | "
+            f"**{_fmt_k(r['value'])} ops/s** batched end to end "
+            "(CreateServiceName → RC-paxos → StartEpoch → majority ack "
+            "→ READY; deletes via paxos stop); per-packet-type stage "
+            f"budget in `info.stage_totals`{ceil} — the 10K target "
+            "needs cores, not protocol: the binding stages are the "
+            "engine's own batched create (w.ar.start_epoch_b) and the "
+            "RC-paxos commit path (w.commits) |")
+
+    r = row("config5_failover_5r")
+    if r:
+        i = r["info"]
+        out.append(
+            "| 5-replica coordinator failover (config 5, native) | "
+            f"{_fmt_k(r['value'])} req/s across the re-election window "
+            f"(pre-kill {_fmt_k(i.get('pre', {}).get('throughput_rps'))}"
+            " req/s); all driven requests decided through the kill |")
+
+    r = row("config5b_mass_takeover_100k")
+    if r:
+        i = r["info"]
+        p = i.get("post_through_failover", {})
+        out.append(
+            f"| MASS takeover, {_fmt_k(i.get('groups'))} groups all led "
+            "by the killed node (config 5b) | re-installed in "
+            f"**{r['value']} s** ({_fmt_k(i.get('groups_per_s'))} "
+            f"installs/s); {_fmt_k(p.get('throughput_rps'))} req/s "
+            "served THROUGH the takeover window "
+            f"({p.get('ok')}/{p.get('requests')} ok); stage budget in "
+            "`info.stage_totals` |")
+
+    r = row("config5c_mass_takeover_1m")
+    if r:
+        i = r["info"]
+        p = i.get("post_through_failover", {})
+        out.append(
+            "| MASS takeover at 1M groups (config 5c, SoA election "
+            "cohort) | re-installed in "
+            f"**{r['value']} s** ({_fmt_k(i.get('groups_per_s'))} "
+            f"installs/s; was 18.9 s on the dict path); "
+            f"{p.get('ok')}/{p.get('requests')} requests served "
+            f"through the window at {_fmt_k(p.get('throughput_rps'))} "
+            "req/s; binding stage now the survivors' prepare side — "
+            "see `info.stage_totals` |")
+
+    for eng in ("native", "columnar"):
+        r = row(f"config6_hot_group_{eng}")
+        if r:
+            i = r["info"]
+            out.append(
+                f"| ONE hot group, closed loop, 3 replicas (config 6, "
+                f"{eng}) | **{_fmt_k(r['value'])} req/s** at the knee "
+                f"depth {i.get('knee_depth')} = W (the slot window is "
+                f"the pipeline bound; p99 {i.get('lat_p99_ms')} ms; "
+                "depth 2W cliffs into retransmit amplification — see "
+                "`info.depth_sweep`) |")
+
+    out.append("")
+    out.append(END)
+    return "\n".join(out)
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--write", action="store_true",
+                   help="splice into README.md between the markers")
+    args = p.parse_args()
+    block = render()
+    if not args.write:
+        print(block)
+        return 0
+    path = os.path.join(HERE, "README.md")
+    with open(path) as f:
+        src = f.read()
+    b, e = src.find(BEGIN), src.find(END)
+    if b < 0 or e < 0:
+        raise SystemExit("README.md markers not found")
+    src = src[:b] + block + src[e + len(END):]
+    with open(path, "w") as f:
+        f.write(src)
+    print("README.md updated")
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())
