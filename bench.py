@@ -115,6 +115,24 @@ def _last_json_line(stdout: bytes) -> str:
     return s.splitlines()[-1] if s else ""
 
 
+def host_cpu_env(base=None):
+    """Env for HOST-XLA measurement children: pin JAX to cpu AND keep
+    the remote-accelerator PJRT plugin from registering at interpreter
+    start.  This host's injected sitecustomize hooks EVERY python
+    process when PALLAS_AXON_POOL_IPS is set and creates the tunnel
+    client during registration — with the tunnel wedged that either
+    hangs the interpreter before main() or poisons per-op dispatch
+    with multi-second stalls (observed: the config2 row collapsing to
+    0.0 req/s in a full-matrix run while the identical workload
+    measured 306 req/s with the plugin excluded).  An empty value is
+    falsy to the sitecustomize gate, so registration is skipped
+    entirely; JAX_PLATFORMS=cpu then makes host XLA the one backend."""
+    env = dict(base if base is not None else os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    return env
+
+
 def bench_columnar(G: int, W: int, B: int, iters: int, warmup: int,
                    trials: int):
     import jax
@@ -410,7 +428,10 @@ def run_full(args) -> int:
                          GP_BENCH_SKIP_E2E="1")
         # probe already said wedged → don't spend the storm watchdog
         # budget rediscovering it; go straight to the labeled fallback
+        # (and exclude the wedged plugin so the fallback can't hang)
         storm_extra = [] if tpu_ok else ["--force-cpu"]
+        if not tpu_ok:
+            storm_env = host_cpu_env(storm_env)
         sub("config3_storm_1m_groups",
             [sys.executable, here] + (["--quick"] if q else [])
             + storm_extra,
@@ -423,7 +444,7 @@ def run_full(args) -> int:
                 "wedged/absent]"
         sub("config1_e2e_3r_1k_groups",
             m + ["throughput", "--requests", "4000" if q else "20000"],
-            300 if q else 420)
+            300 if q else 420, env=host_cpu_env())
         # config 2 ships TWO rows (round-4 verdict ask #2): the
         # host-XLA KNEE (the operating point: depth auto-tuned to max
         # throughput under a 500ms p99 bound, with the w.* stage budget
@@ -437,7 +458,7 @@ def run_full(args) -> int:
                "--requests", "1000" if q else "4000",
                "--concurrency", "448", "--pipeline", "--sweep"]
         sub("config2_columnar_100k_groups_host_xla_knee",
-            m + col, 420 if q else 900)
+            m + col, 420 if q else 900, env=host_cpu_env())
         if tpu_ok and not q:
             sub("config2_columnar_on_device",
                 m + ["throughput", "--backend", "columnar",
@@ -452,15 +473,15 @@ def run_full(args) -> int:
             m + ["churn", "--via-reconfigurator",
                  "--requests", "2000" if q else "20000"],
             300 if q else 600,
-            env=dict(os.environ, GP_PC_PROFILE_CPU="1"))
+            env=host_cpu_env(dict(os.environ, GP_PC_PROFILE_CPU="1")))
         sub("config5_failover_5r",
             m + ["failover", "--requests", "1000" if q else "5000"],
-            300 if q else 420)
+            300 if q else 420, env=host_cpu_env())
         sub("config5b_mass_takeover_100k",
             m + ["failover", "--single-coordinator",
                  "--groups", "5000" if q else "100000",
                  "--requests", "1000"],
-            300 if q else 420)
+            300 if q else 420, env=host_cpu_env())
         if not q:
             # the 1M-scale variant (round-4 verdict ask #5): served-
             # during-takeover throughput and the fo.*/w.prepare* stage
@@ -469,7 +490,7 @@ def run_full(args) -> int:
             sub("config5c_mass_takeover_1m",
                 m + ["failover", "--single-coordinator",
                      "--groups", "1000000", "--requests", "2000"],
-                900)
+                900, env=host_cpu_env())
         # config 6 (round-4 verdict ask #6): the OTHER extreme — one
         # hot group, closed loop, 3 replicas — exercises the W=16
         # slot window as the pipeline bound (both engines knee at
@@ -481,7 +502,7 @@ def run_full(args) -> int:
                 m + ["throughput", "--backend", eng, "--groups", "1",
                      "--requests", "2000" if q else "6000",
                      "--concurrency", "128", "--sweep"] + extra,
-                300 if q else 500)
+                300 if q else 500, env=host_cpu_env())
 
     out = {
         "recorded_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
@@ -554,9 +575,12 @@ def main():
             except subprocess.TimeoutExpired:
                 reason = f"accelerator hung (> {budget}s)"
         try:
+            # host-XLA fallback: exclude the wedged plugin entirely —
+            # with it registered, the fallback child itself can hang at
+            # interpreter start (see host_cpu_env)
             res = subprocess.run(
                 argv + ["--force-cpu"], capture_output=True,
-                timeout=budget)
+                timeout=budget, env=host_cpu_env())
         except subprocess.TimeoutExpired:
             sys.stderr.write(
                 f"bench: fallback also exceeded {budget}s\n")

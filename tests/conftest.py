@@ -21,6 +21,14 @@ import jax  # noqa: E402
 
 if not os.environ.get("GP_TEST_TPU"):
     jax.config.update("jax_platforms", "cpu")
+    # children spawned by tests (server subprocesses, loadgen) inherit
+    # os.environ: pin them to host XLA too, and keep the injected
+    # remote-accelerator sitecustomize from registering its PJRT plugin
+    # in each child (with the tunnel wedged, registration can hang the
+    # child interpreter before it reaches our code — observed on this
+    # host; empty string is falsy to the sitecustomize gate)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["PALLAS_AXON_POOL_IPS"] = ""
 
 from gigapaxos_tpu.utils.jaxcache import enable_persistent_cache  # noqa: E402
 
