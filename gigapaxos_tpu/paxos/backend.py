@@ -407,11 +407,15 @@ class NativeBackend(AcceptorBackend):
 
 
 def _bucket(n: int, lo: int = 8) -> int:
-    """Smallest power-of-two >= n (>= lo).  Unbounded: the number of jit
-    specializations grows with log2(max batch), not with batch count."""
+    """Smallest 8**k * lo >= n.  Coarse on purpose: each (op, bucket)
+    pair is one jit specialization, and at serving capacity a single
+    compile costs ~10-20s of one-core wall — a x2 ladder was paying
+    that up to 7 times per op mid-measurement.  A x8 ladder caps the
+    runtime ladder at {8, 64, 512, 4096} while the padding it adds is
+    vectorized-lane work measured in microseconds."""
     b = lo
     while b < n:
-        b <<= 1
+        b <<= 3
     return b
 
 
@@ -427,7 +431,15 @@ class ColumnarBackend(AcceptorBackend):
                  use_pallas_accept: Optional[bool] = None,
                  mesh=None):
         import jax
+
         from gigapaxos_tpu.ops import kernels, make_state
+        from gigapaxos_tpu.utils.jaxcache import enable_persistent_cache
+
+        # warm compiles for every process after the first: the packed
+        # kernels at serving capacity take ~10-20s EACH to compile on a
+        # one-core host, and without the persistent cache the node pays
+        # that mid-measurement for every (op, bucket) specialization
+        enable_persistent_cache()
         self._jax = jax
         self._k = kernels
         self.state = make_state(capacity, window)
@@ -447,15 +459,23 @@ class ColumnarBackend(AcceptorBackend):
         # defaults to host XLA — per-batch calls pay a host<->device
         # round trip each, which over a remote/tunneled accelerator
         # costs more than the kernel itself
-        devs = jax.local_devices()
         pinned = False
+        # default platform from CONFIG (a string check) — NOT
+        # jax.default_backend(), which initializes the default
+        # platform, and on this host that can be a wedged
+        # remote-tunnel plugin that stalls or hangs backend init; a
+        # cpu-pinned node must never touch it
+        cpu_is_default = (str(getattr(jax.config, "jax_platforms", "")
+                              or "").split(",")[0] == "cpu")
         if str(_Cfg.get(_PC.COLUMNAR_DEVICE)) == "cpu" and \
-                jax.default_backend() != "cpu":
+                not cpu_is_default:
             try:
                 devs = jax.local_devices(backend="cpu")
                 pinned = True
             except RuntimeError:
-                pass  # no cpu backend registered: stay on default
+                devs = jax.local_devices()  # no cpu backend: default
+        else:
+            devs = jax.local_devices()
         if self._mesh is None and \
                 str(_Cfg.get(_PC.COLUMNAR_MESH)) == "auto" and \
                 len(devs) > 1 and capacity % len(devs) == 0:
@@ -488,7 +508,10 @@ class ColumnarBackend(AcceptorBackend):
         if use_pallas_accept:
             try:
                 from gigapaxos_tpu.ops.pallas_accept import PallasAccept
-                on_tpu = jax.devices()[0].platform != "cpu"
+                # devs[0] (the resolved engine device), NOT
+                # jax.devices()[0]: the latter would initialize the
+                # default platform a cpu-pinned node must avoid
+                on_tpu = devs[0].platform != "cpu"
                 pal = PallasAccept(interpret=not on_tpu)
                 probe = np.zeros(1, np.int32)
                 st, _out = pal(self.state, probe, probe, probe, probe,
