@@ -459,7 +459,11 @@ def run_full(args) -> int:
                "--concurrency", "448", "--pipeline", "--sweep"]
         sub("config2_columnar_100k_groups_host_xla_knee",
             m + col, 420 if q else 900, env=host_cpu_env())
-        if tpu_ok and not q:
+        # re-probe NOW, not at matrix start: the tunnel can wedge
+        # mid-matrix (observed: healthy probe at t=0, storm child
+        # watchdogged at t+15min), and a wedged on-device run burns
+        # its whole 900s timeout producing nothing
+        if tpu_ok and not q and probe_platform(60) not in (None, "cpu"):
             sub("config2_columnar_on_device",
                 m + ["throughput", "--backend", "columnar",
                      "--groups", "20000", "--capacity", str(1 << 15),
