@@ -507,6 +507,15 @@ def run_full(args) -> int:
                      "--requests", "2000" if q else "6000",
                      "--concurrency", "128", "--sweep"] + extra,
                 300 if q else 500, env=host_cpu_env())
+        if not q:
+            # the W knob IS the single-group ceiling: the same hot
+            # group with a 64-slot window knees at depth 64 at ~1.7x
+            # the W=16 rate (slot-window bound, not engine bound)
+            sub("config6b_hot_group_native_w64",
+                m + ["throughput", "--backend", "native", "--groups",
+                     "1", "--requests", "6000", "--concurrency", "128",
+                     "--window", "64", "--sweep"],
+                500, env=host_cpu_env())
 
     out = {
         "recorded_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),

@@ -202,6 +202,16 @@ def render() -> str:
                 "depth 2W cliffs into retransmit amplification — see "
                 "`info.depth_sweep`) |")
 
+    r = row("config6b_hot_group_native_w64")
+    if r:
+        i = r["info"]
+        out.append(
+            "| Same hot group, 64-slot window (config 6b, native) | "
+            f"**{_fmt_k(r['value'])} req/s** at knee depth "
+            f"{i.get('knee_depth')} (p99 {i.get('lat_p99_ms')} ms) — "
+            "the window knob, not the engine, sets the single-group "
+            "ceiling |")
+
     out.append("")
     out.append(END)
     return "\n".join(out)
