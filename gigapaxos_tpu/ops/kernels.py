@@ -626,6 +626,21 @@ def commit_packed(state: ColumnarState, packed):
         o.out_window.astype(i32), o.new_cursor])
 
 
+def accept_commit_packed(state: ColumnarState, acc, com):
+    """Fused ACCEPTOR wave: accepts for the new slots and commits for
+    the older ones land in the same worker batch on every acceptor, and
+    the unfused runtime paid two device dispatches for it.  Sequential
+    composition of the same packed bodies, in the same order the
+    manager's handlers run them (accepts first, then commits), so the
+    state transition is bit-identical to the two-call path — the jit
+    boundary is the only thing that moved.  Both inputs are padded to
+    ONE shared bucket by the caller, bounding this kernel's jit cache
+    to the ladder size."""
+    state, aout = accept_packed(state, acc)
+    state, cout = commit_packed(state, com)
+    return state, aout, cout
+
+
 # --------------------------------------------------------------------------
 # jit entry points
 # --------------------------------------------------------------------------
@@ -645,6 +660,7 @@ accept_reply_commit_self_p = jax.jit(accept_reply_commit_self_packed,
 accept_p = jax.jit(accept_packed, donate_argnums=0)
 accept_reply_p = jax.jit(accept_reply_packed, donate_argnums=0)
 commit_p = jax.jit(commit_packed, donate_argnums=0)
+accept_commit_p = jax.jit(accept_commit_packed, donate_argnums=0)
 prepare = jax.jit(prepare_batch, donate_argnums=0)
 install_coordinator = jax.jit(install_coordinator_batch, donate_argnums=0)
 create_groups = jax.jit(create_groups_batch, donate_argnums=0)

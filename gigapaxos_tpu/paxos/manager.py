@@ -1458,21 +1458,35 @@ class PaxosNode:
                 len(reqs) + len(props) + sum(len(s.gkey) for s in soas),
                 cpu_t0=c0)
         accepts = by_type.pop(pkt.AcceptBatch, [])
-        if accepts:
+        commits = by_type.pop(pkt.CommitBatch, [])
+        replies = by_type.pop(pkt.AcceptReplyBatch, [])
+        fuse_wave = accepts and commits and self._fused is None
+        if fuse_wave:
+            # fused acceptor wave: both types -> ONE device dispatch.
+            # Safe to hoist commits past replies: the commit kernel
+            # writes dec/exec state only, the reply kernel reads vote/
+            # coordinator state only (they commute), and commits in
+            # this batch are from prior waves.  The C-engine path keeps
+            # the split handlers (its per-stage calls are sub-ms).
+            t0 = time.monotonic()
+            c0 = self._ct()
+            self._handle_accepts_commits(accepts, commits)
+            DelayProfiler.update_total(
+                "w.acc_com", t0, len(accepts) + len(commits),
+                cpu_t0=c0)
+        elif accepts:
             t0 = time.monotonic()
             c0 = self._ct()
             self._handle_accepts(accepts)
             DelayProfiler.update_total("w.accepts", t0, len(accepts),
                                        cpu_t0=c0)
-        replies = by_type.pop(pkt.AcceptReplyBatch, [])
         if replies:
             t0 = time.monotonic()
             c0 = self._ct()
             self._handle_accept_replies(replies)
             DelayProfiler.update_total("w.replies", t0, len(replies),
                                        cpu_t0=c0)
-        commits = by_type.pop(pkt.CommitBatch, [])
-        if commits:
+        if commits and not fuse_wave:
             t0 = time.monotonic()
             c0 = self._ct()
             self._handle_commits(commits)
@@ -2005,19 +2019,36 @@ class PaxosNode:
             for dst, arb in out:
                 self._route(dst, arb)
             return
+        pre = self._acc_pre(rows_all, slots_all, bals_all, reqs_all,
+                            send_all)
+        if pre is None:
+            return
+        idxs, rows, slots, bals, req_ids, senders, now = pre
+        res = self.backend.accept(rows, slots, bals, req_ids)
+        self._acc_post(objs, gkeys, idxs, rows, slots, bals, req_ids,
+                       senders, now, res)
+
+    def _acc_pre(self, rows_all, slots_all, bals_all, reqs_all,
+                 send_all):
+        """Host half of the acceptor path BEFORE the engine call:
+        (row, slot) max-ballot coalesce + liveness stamp.  Split out so
+        the fused accept+commit wave can run it, make ONE device call,
+        and hand the outputs to :meth:`_acc_post`."""
         keep = native.coalesce_max(rows_all, slots_all, bals_all)
         if not keep.any():
-            return
+            return None
         idxs = np.flatnonzero(keep)
         rows = rows_all[idxs]
-        slots = slots_all[idxs]
-        bals = bals_all[idxs]
-        req_ids = reqs_all[idxs]
-        senders = send_all[idxs]
         now = time.time()
         self._la[rows] = now
-        res = self.backend.accept(rows, slots, bals, req_ids)
+        return (idxs, rows, slots_all[idxs], bals_all[idxs],
+                reqs_all[idxs], send_all[idxs], now)
 
+    def _acc_post(self, objs, gkeys, idxs, rows, slots, bals, req_ids,
+                  senders, now, res) -> None:
+        """Host half AFTER the engine call: mirrors, payload store, WAL
+        (fsync BEFORE replies leave — the durability barrier is in this
+        half, so fusing the device call cannot reorder it), replies."""
         acked = np.asarray(res.acked)
         arows = rows[acked]
         # vectorized mirrors: catch-up watermark + max ballot seen
@@ -2060,6 +2091,48 @@ class PaxosNode:
             self.logger.log_raw_inline(wal_buf, n_entries=len(ai))
         for dst, arb in out:
             self._route(dst, arb)
+
+    def _handle_accepts_commits(self, accepts: List,
+                                commits: List) -> None:
+        """Fused acceptor wave: the accepts and commits of one worker
+        batch go to the engine in ONE device dispatch
+        (``backend.accept_commit`` → ``kernels.accept_commit_p``),
+        with the host halves unchanged and in the split handlers'
+        order — accept post (payload store + WAL durability barrier +
+        replies) runs before commit post (install + execute)."""
+        a_gkeys = _cat(accepts, lambda o: np.asarray(o.gkey, np.uint64))
+        a_slots = _cat(accepts, lambda o: np.asarray(o.slot, np.int32))
+        a_bals = _cat(accepts, lambda o: np.asarray(o.bal, np.int32))
+        a_reqs = _cat(accepts, lambda o: _merge_req(o.req_lo, o.req_hi))
+        a_send = _cat(accepts, lambda o: np.full(len(o.gkey), o.sender,
+                                                 np.int32))
+        apre = self._acc_pre(self._rows_for_keys(a_gkeys), a_slots,
+                             a_bals, a_reqs, a_send)
+        c_gkeys = _cat(commits, lambda o: np.asarray(o.gkey, np.uint64))
+        c_slots = _cat(commits, lambda o: np.asarray(o.slot, np.int32))
+        c_bals = _cat(commits, lambda o: np.asarray(o.bal, np.int32))
+        c_reqs = _cat(commits, lambda o: _merge_req(o.req_lo, o.req_hi))
+        cpre = self._commit_pre(self._rows_for_keys(c_gkeys), c_slots,
+                                c_bals, c_reqs, time.time())
+        if apre is not None and cpre is not None:
+            idxs, rows, slots, bals, req_ids, senders, now = apre
+            sel, rows_s, slots_s, reqs_s = cpre
+            ares, cres = self.backend.accept_commit(
+                rows, slots, bals, req_ids, rows_s, slots_s, reqs_s)
+            self._acc_post(accepts, a_gkeys, idxs, rows, slots, bals,
+                           req_ids, senders, now, ares)
+            self._commit_post(c_gkeys, sel, rows_s, slots_s, reqs_s,
+                              cres)
+        elif apre is not None:
+            idxs, rows, slots, bals, req_ids, senders, now = apre
+            res = self.backend.accept(rows, slots, bals, req_ids)
+            self._acc_post(accepts, a_gkeys, idxs, rows, slots, bals,
+                           req_ids, senders, now, res)
+        elif cpre is not None:
+            sel, rows_s, slots_s, reqs_s = cpre
+            res = self.backend.commit(rows_s, slots_s, reqs_s)
+            self._commit_post(c_gkeys, sel, rows_s, slots_s, reqs_s,
+                              res)
 
     # -- accept replies (coordinator side) ------------------------------
 
@@ -2198,9 +2271,20 @@ class PaxosNode:
             for i in np.flatnonzero(ow_m):
                 self._sync_if_gap(int(rows[i]))
             return
+        pre = self._commit_pre(rows, slots, bals, req_ids, now)
+        if pre is None:
+            return
+        sel, rows_s, slots_s, reqs_s = pre
+        res = self.backend.commit(rows_s, slots_s, reqs_s)
+        self._commit_post(gkeys, sel, rows_s, slots_s, reqs_s, res)
+
+    def _commit_pre(self, rows, slots, bals, req_ids, now):
+        """Host half of the commit path BEFORE the engine call: ballot
+        mirror + (row, slot) keep-LAST dedupe + liveness stamp (split
+        for the fused accept+commit wave, like :meth:`_acc_pre`)."""
         live = rows >= 0
         if not live.any():
-            return
+            return None
         np.maximum.at(self._bal, rows[live], bals[live])
         # dedupe (row, slot) keep-LAST (later packets carry newer bal)
         key = ((rows.astype(np.uint64) << np.uint64(32))
@@ -2209,10 +2293,13 @@ class PaxosNode:
         _, first_rev = np.unique(rev, return_index=True)
         sel = np.flatnonzero(live)[len(rev) - 1 - first_rev]
         rows_s = rows[sel]
-        slots_s = slots[sel]
-        reqs_s = req_ids[sel]
         self._la[rows_s] = now
-        res = self.backend.commit(rows_s, slots_s, reqs_s)
+        return sel, rows_s, slots[sel], req_ids[sel]
+
+    def _commit_post(self, gkeys, sel, rows_s, slots_s, reqs_s,
+                     res) -> None:
+        """Host half AFTER the engine call: decision WAL, install,
+        in-order execute, gap sync."""
         applied = np.asarray(res.applied)
         if applied.any():
             self.logger.log_raw_inline(native.encode_wal(
