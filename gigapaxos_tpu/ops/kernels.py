@@ -626,6 +626,21 @@ def commit_packed(state: ColumnarState, packed):
         o.out_window.astype(i32), o.new_cursor])
 
 
+def request_reply_packed(state: ColumnarState, req, rep):
+    """Fused COORDINATOR wave: new proposals and accept-replies of one
+    worker batch in ONE device dispatch — sequential composition of
+    :func:`propose_accept_self_packed` then
+    :func:`accept_reply_commit_self_packed`, the order the split
+    handlers run them.  The two stages touch disjoint window columns:
+    a replied slot s is still undecided (cursor <= s), and the propose
+    stage only assigns s' with s' - cursor < W, so s' % W == s % W
+    would require s' == s, which the slot counter forbids — the
+    window invariant, not luck, keeps the composition exact."""
+    state, pout = propose_accept_self_packed(state, req)
+    state, rout = accept_reply_commit_self_packed(state, rep)
+    return state, pout, rout
+
+
 def accept_commit_packed(state: ColumnarState, acc, com):
     """Fused ACCEPTOR wave: accepts for the new slots and commits for
     the older ones land in the same worker batch on every acceptor, and
@@ -661,6 +676,7 @@ accept_p = jax.jit(accept_packed, donate_argnums=0)
 accept_reply_p = jax.jit(accept_reply_packed, donate_argnums=0)
 commit_p = jax.jit(commit_packed, donate_argnums=0)
 accept_commit_p = jax.jit(accept_commit_packed, donate_argnums=0)
+request_reply_p = jax.jit(request_reply_packed, donate_argnums=0)
 prepare = jax.jit(prepare_batch, donate_argnums=0)
 install_coordinator = jax.jit(install_coordinator_batch, donate_argnums=0)
 create_groups = jax.jit(create_groups_batch, donate_argnums=0)

@@ -532,6 +532,33 @@ class ColumnarBackend(AcceptorBackend):
                 from gigapaxos_tpu.utils.logutil import get_logger
                 get_logger("gp.backend").exception(
                     "pallas accept unavailable; using XLA scatter path")
+        self._warm_kernels()
+
+    def _warm_kernels(self) -> None:
+        """Compile the hot serving kernels on all-padding inputs at the
+        smallest bucket NOW, at construction, instead of mid-serving:
+        a cold first-touch compile (~2-20 s at serving capacities on a
+        one-core host) landing inside a request window reads as a
+        multi-second latency spike or a client timeout.  All-invalid
+        lanes make every warm call a state no-op; with the persistent
+        cache this is a disk load after the first process on a
+        machine.  Larger buckets still compile on first use — the load
+        ramp, not the trickle path, absorbs those."""
+        k, b = self._k, _bucket(0)
+
+        def z(rows_):
+            return self._dev(np.zeros((rows_, b), np.int32))
+
+        st = self.state
+        st, _ = k.propose_p(st, z(4))
+        st, _ = k.accept_p(st, z(6))
+        st, _ = k.accept_reply_p(st, z(6))
+        st, _ = k.commit_p(st, z(5))
+        st, _ = k.propose_accept_self_p(st, z(5))
+        st, _ = k.accept_reply_commit_self_p(st, z(6))
+        st, _, _ = k.accept_commit_p(st, z(6), z(5))
+        st, _, _ = k.request_reply_p(st, z(5), z(6))
+        self.state = st
 
     @property
     def window(self) -> int:
@@ -708,6 +735,36 @@ class ColumnarBackend(AcceptorBackend):
         pr = ProposeRes(granted, out[1] != 0, out[2] != 0,
                         np.where(granted, out[3], NO_SLOT), out[4])
         return pr, out[5] != 0, out[6] != 0, out[7] != 0, out[8]
+
+    def propose_self_reply(self, rows_p, reqs_p, self_midx,
+                           rows_r, slots_r, bals_r, senders_r, acked_r):
+        """Fused coordinator wave (ONE device call;
+        kernels.request_reply_p): new proposals + accept replies of the
+        same worker batch.  Returns what :meth:`propose_self` and
+        :meth:`accept_reply_commit_self` return, as a pair.  Shared
+        bucket bounds the composed kernel's jit cache to the ladder."""
+        np_, nr = len(rows_p), len(rows_r)
+        b = _bucket(max(np_, nr))
+        lo_p, hi_p = _split64(reqs_p)
+        self.state, po, ro = self._k.request_reply_p(
+            self.state,
+            self._packed(np_, (rows_p, 0), (lo_p, 0), (hi_p, 0),
+                         (self_midx, 0), bucket=b),
+            self._packed(nr, (rows_r, 0), (slots_r, NO_SLOT),
+                         (bals_r, NO_BALLOT), (senders_r, 0),
+                         (np.asarray(acked_r, np.int32), 0), bucket=b))
+        p = np.asarray(po)[:, :np_]
+        r = np.asarray(ro)[:, :nr]
+        granted = p[0] != 0
+        pres = (ProposeRes(granted, p[1] != 0, p[2] != 0,
+                           np.where(granted, p[3], NO_SLOT), p[4]),
+                p[5] != 0, p[6] != 0, p[7] != 0, p[8])
+        newly = r[0] != 0
+        rres = (AcceptReplyRes(
+            newly, r[1] != 0, np.where(newly, r[3], 0),
+            np.where(newly, r[4], 0),
+            np.where(newly, r[2], NO_BALLOT)), r[6] != 0, r[7] != 0)
+        return pres, rres
 
     def prepare(self, rows, bals) -> PrepareRes:
         n = len(rows)

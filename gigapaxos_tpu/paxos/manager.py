@@ -1449,7 +1449,26 @@ class PaxosNode:
         reqs = by_type.pop(pkt.Request, [])
         props = by_type.pop(pkt.Proposal, [])
         soas = by_type.pop(_ReqSoA, [])
-        if reqs or props or soas:
+        accepts = by_type.pop(pkt.AcceptBatch, [])
+        commits = by_type.pop(pkt.CommitBatch, [])
+        replies = by_type.pop(pkt.AcceptReplyBatch, [])
+        # fused coordinator wave (columnar): requests + replies in one
+        # device dispatch.  Reply-side state (votes/cbal) and accept-
+        # side state (bal/acc_*) are disjoint on device, and a node
+        # only receives accepts for groups it does NOT coordinate and
+        # replies for groups it does, so hoisting replies past accepts
+        # cannot reorder same-group work.
+        fuse_coord = bool(replies) and (reqs or props or soas) \
+            and self._col_self is not None and self._fused is None
+        if fuse_coord:
+            t0 = time.monotonic()
+            c0 = self._ct()
+            self._handle_requests_replies(reqs, props, soas, replies)
+            DelayProfiler.update_total(
+                "w.req_rep", t0,
+                len(reqs) + len(props) + len(replies)
+                + sum(len(s.gkey) for s in soas), cpu_t0=c0)
+        elif reqs or props or soas:
             t0 = time.monotonic()
             c0 = self._ct()
             self._handle_requests(reqs, props, soas)
@@ -1457,9 +1476,6 @@ class PaxosNode:
                 "w.requests", t0,
                 len(reqs) + len(props) + sum(len(s.gkey) for s in soas),
                 cpu_t0=c0)
-        accepts = by_type.pop(pkt.AcceptBatch, [])
-        commits = by_type.pop(pkt.CommitBatch, [])
-        replies = by_type.pop(pkt.AcceptReplyBatch, [])
         fuse_wave = accepts and commits and self._fused is None
         if fuse_wave:
             # fused acceptor wave: both types -> ONE device dispatch.
@@ -1480,7 +1496,7 @@ class PaxosNode:
             self._handle_accepts(accepts)
             DelayProfiler.update_total("w.accepts", t0, len(accepts),
                                        cpu_t0=c0)
-        if replies:
+        if replies and not fuse_coord:
             t0 = time.monotonic()
             c0 = self._ct()
             self._handle_accept_replies(replies)
@@ -1595,6 +1611,25 @@ class PaxosNode:
 
     def _handle_requests(self, reqs: List, props: List,
                          soas: Tuple = ()) -> None:
+        pre = self._req_pre(reqs, props, soas)
+        if pre is None:
+            return
+        rows, req_ids, flag_parts, pay_parts, now = pre
+        if self._col_self is not None:
+            res, self_acked, self_newly, self_pre, self_cur = \
+                self.backend.propose_self(rows, req_ids,
+                                          self._self_midx(rows))
+        else:
+            self_acked = None
+            self_newly = self_pre = self_cur = None
+            res = self.backend.propose(rows, req_ids)
+        self._req_post(rows, req_ids, flag_parts, pay_parts, now, res,
+                       self_acked, self_newly, self_pre, self_cur)
+
+    def _req_pre(self, reqs: List, props: List, soas: Tuple = ()):
+        """Host half of the request path BEFORE the engine call: shed,
+        dedupe, forward/park, lane assembly (split out for the fused
+        coordinator wave)."""
         # congestion-collapse guard (PC.INTAKE_BACKLOG_LIMIT): a deep
         # inbound backlog means the engine is past its knee.  Shed a
         # PROPORTIONAL share of fresh client work (RED-style: ramps from
@@ -1823,19 +1858,23 @@ class PaxosNode:
             flag_parts.extend(l[2] for l in lanes)
             pay_parts.extend(l[3] for l in lanes)
         if not rows_parts:
-            return
+            return None
         rows = np.concatenate(rows_parts).astype(np.int32, copy=False)
         req_ids = np.concatenate(req_parts)
         self._la[rows] = now
-        if self._col_self is not None:
-            smidx = np.argmax(
-                self._member_mat[rows] == self.id, axis=1).astype(
-                    np.int32)
-            res, self_acked, self_newly, self_pre, self_cur = \
-                self.backend.propose_self(rows, req_ids, smidx)
-        else:
-            self_acked = None
-            res = self.backend.propose(rows, req_ids)
+        return rows, req_ids, flag_parts, pay_parts, now
+
+    def _self_midx(self, rows) -> np.ndarray:
+        """This node's member index per row (the fused self kernels
+        need it to set the right vote bit)."""
+        return np.argmax(self._member_mat[rows] == self.id,
+                         axis=1).astype(np.int32)
+
+    def _req_post(self, rows, req_ids, flag_parts, pay_parts, now, res,
+                  self_acked, self_newly, self_pre, self_cur) -> None:
+        """Host half of the request path AFTER the engine call:
+        in-flight bookkeeping, payload store, fused-self WAL barrier,
+        accept emission (split out for the fused coordinator wave)."""
         granted = np.asarray(res.granted)
         bal_of = self._bal[rows]
         slot_arr = np.asarray(res.slot)
@@ -2134,6 +2173,45 @@ class PaxosNode:
             self._commit_post(c_gkeys, sel, rows_s, slots_s, reqs_s,
                               res)
 
+    def _handle_requests_replies(self, reqs: List, props: List,
+                                 soas: Tuple, replies: List) -> None:
+        """Fused coordinator wave: new proposals + accept replies of
+        one worker batch in ONE device dispatch
+        (``backend.propose_self_reply`` → ``kernels.request_reply_p``),
+        host halves unchanged and in split-handler order (request post
+        — with its fused-self WAL barrier — before reply post's
+        decision fan-out)."""
+        rpre = self._req_pre(reqs, props, soas)
+        r_gkeys = _cat(replies, lambda o: np.asarray(o.gkey, np.uint64))
+        r_slots = _cat(replies, lambda o: np.asarray(o.slot, np.int32))
+        r_bals = _cat(replies, lambda o: np.asarray(o.bal, np.int32))
+        r_acked = _cat(replies, lambda o: np.asarray(o.acked, np.uint8))
+        r_send = _cat(replies, lambda o: np.full(len(o.gkey), o.sender,
+                                                 np.int32))
+        ppre = self._rep_pre(self._rows_for_keys(r_gkeys), r_slots,
+                             r_bals, r_send, r_acked)
+        if rpre is not None and ppre is not None:
+            rows, req_ids, flag_parts, pay_parts, now = rpre
+            sel, rr, rs, rb, sidx_s, acked_s = ppre
+            (pres, sa, sn, sp, sc), (rres, c_app, c_st) = \
+                self.backend.propose_self_reply(
+                    rows, req_ids, self._self_midx(rows),
+                    rr, rs, rb, sidx_s, acked_s)
+            self._req_post(rows, req_ids, flag_parts, pay_parts, now,
+                           pres, sa, sn, sp, sc)
+            self._rep_post(r_gkeys, sel, rr, rs, rb, rres, c_app, c_st)
+        elif rpre is not None:
+            rows, req_ids, flag_parts, pay_parts, now = rpre
+            res, sa, sn, sp, sc = self.backend.propose_self(
+                rows, req_ids, self._self_midx(rows))
+            self._req_post(rows, req_ids, flag_parts, pay_parts, now,
+                           res, sa, sn, sp, sc)
+        elif ppre is not None:
+            sel, rr, rs, rb, sidx_s, acked_s = ppre
+            res, c_app, c_st = self.backend.accept_reply_commit_self(
+                rr, rs, rb, sidx_s, acked_s)
+            self._rep_post(r_gkeys, sel, rr, rs, rb, res, c_app, c_st)
+
     # -- accept replies (coordinator side) ------------------------------
 
     def _handle_accept_replies(self, objs: List) -> None:
@@ -2163,6 +2241,27 @@ class PaxosNode:
             self._emit_commits(nrows, gkeys[newly], slots_a[newly],
                                dec_bal[newly], cb_rlo, cb_rhi)
             return
+        pre = self._rep_pre(all_rows, slots_a, bals_a, send_a, acked_a)
+        if pre is None:
+            return
+        sel, rows, slots, bals, sidx_s, acked_s = pre
+        if self._col_self is not None:
+            # fused decide wave: our own commit applied in the same
+            # device call as the vote counting
+            res, c_applied, c_stale = \
+                self.backend.accept_reply_commit_self(
+                    rows, slots, bals, sidx_s, acked_s)
+        else:
+            c_applied = c_stale = None
+            res = self.backend.accept_reply(rows, slots, bals, sidx_s,
+                                            acked_s)
+        self._rep_post(gkeys, sel, rows, slots, bals, res, c_applied,
+                       c_stale)
+
+    def _rep_pre(self, all_rows, slots_a, bals_a, send_a, acked_a):
+        """Host half of the reply path BEFORE the engine call:
+        sender->member-index resolution + (row, slot, sender) dedupe
+        (split out for the fused coordinator wave)."""
         # sender -> member index, vectorized over the membership matrix
         mm = self._member_mat[np.where(all_rows >= 0, all_rows, 0)]
         sender_hits = mm == send_a[:, None]
@@ -2175,21 +2274,14 @@ class PaxosNode:
         _, first = np.unique(key[valid], return_index=True)
         sel = np.flatnonzero(valid)[first]
         if not len(sel):
-            return
-        rows = all_rows[sel]
-        slots = slots_a[sel]
-        bals = bals_a[sel]
-        if self._col_self is not None:
-            # fused decide wave: our own commit applied in the same
-            # device call as the vote counting
-            res, c_applied, c_stale = \
-                self.backend.accept_reply_commit_self(
-                    rows, slots, bals, sidx[sel],
-                    acked_a[sel].astype(bool))
-        else:
-            c_applied = None
-            res = self.backend.accept_reply(rows, slots, bals, sidx[sel],
-                                            acked_a[sel].astype(bool))
+            return None
+        return (sel, all_rows[sel], slots_a[sel], bals_a[sel],
+                sidx[sel], acked_a[sel].astype(bool))
+
+    def _rep_post(self, gkeys, sel, rows, slots, bals, res, c_applied,
+                  c_stale) -> None:
+        """Host half AFTER the engine call: preemption adoption,
+        decision fan-out, fused self-commit bookkeeping."""
         # preemption: a higher ballot exists; adopt belief, stop leading
         pre = np.asarray(res.preempted)
         np.maximum.at(self._bal, rows[pre], bals[pre])

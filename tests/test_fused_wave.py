@@ -90,3 +90,42 @@ def test_fused_wave_empty_lane_padding():
     assert _tree_equal(st0, st1)
     assert not np.asarray(ao)[0].any()
     assert not np.asarray(co)[0].any()
+
+
+def test_fused_coord_wave_matches_sequential():
+    """request_reply_packed == propose_accept_self then
+    accept_reply_commit_self, bit-identical state and outputs."""
+    me = 0
+    bal = pack_ballot(1, me)
+    B = 8
+    st0 = _mkstate()
+    rows = jnp.arange(8, dtype=jnp.int32)
+    # make `me` coordinator with an outstanding proposal on group 0
+    st0, _ = kernels.install_coordinator(
+        st0, rows, jnp.full(8, bal, jnp.int32), jnp.zeros(8, jnp.int32),
+        jnp.full((8, 8), NO_SLOT, jnp.int32), jnp.zeros((8, 8), jnp.int32),
+        jnp.zeros((8, 8), jnp.int32), jnp.ones(8, bool))
+    lo, hi = split_req_id(301)
+    seed = _pack([[0], [lo], [hi], [0]], [0, 0, 0, 0], B, 1)
+    st0, _ = kernels.propose_accept_self_p(st0, seed)  # slot 0 in flight
+
+    # wave: new request on group 1 + a peer ack for group 0 slot 0
+    plo, phi = split_req_id(302)
+    req = _pack([[1], [plo], [phi], [0]], [0, 0, 0, 0], B, 1)
+    rep = _pack([[0], [0], [bal], [1], [1]],
+                [0, NO_SLOT, NO_BALLOT, 0, 0], B, 1)
+
+    st_f = jax.tree_util.tree_map(lambda x: jnp.array(x), st0)
+    st_s = jax.tree_util.tree_map(lambda x: jnp.array(x), st0)
+
+    st_f, po_f, ro_f = kernels.request_reply_p(st_f, req, rep)
+    st_s, po_s = kernels.propose_accept_self_p(st_s, req)
+    st_s, ro_s = kernels.accept_reply_commit_self_p(st_s, rep)
+
+    assert np.array_equal(np.asarray(po_f), np.asarray(po_s))
+    assert np.array_equal(np.asarray(ro_f), np.asarray(ro_s))
+    assert _tree_equal(st_f, st_s)
+    # semantics: the peer ack + our own fused vote = quorum of 2/3 ->
+    # group 0 slot 0 newly decided; group 1 got slot 0 granted
+    assert int(np.asarray(ro_f)[0, 0]) == 1
+    assert int(np.asarray(po_f)[0, 0]) == 1
