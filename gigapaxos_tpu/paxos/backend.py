@@ -137,6 +137,8 @@ class AcceptorBackend(abc.ABC):
         they can gather many rows in one device round trip."""
         return [self.snapshot_row(int(r)) for r in rows]
 
+    engine_platform = "cpu"  # overridden by device-resident backends
+
     def accept_commit(self, rows_a, slots_a, bals_a, reqs_a,
                       rows_c, slots_c, reqs_c
                       ) -> Tuple[AcceptRes, CommitRes]:
@@ -506,6 +508,7 @@ class ColumnarBackend(AcceptorBackend):
         # fused Pallas accept path (ops/pallas_accept.py): opt-in via
         # arg or PC.USE_PALLAS_ACCEPT; one probe call decides — Mosaic
         # constraints or a CPU-only build fall back to the XLA scatters
+        self.engine_platform = devs[0].platform
         self._pallas = None
         from gigapaxos_tpu.utils.config import Config
         from gigapaxos_tpu.paxos.paxosconfig import PC

@@ -284,6 +284,17 @@ class PaxosNode:
         # which on a remote accelerator is two fewer link round trips
         self._col_self = self.backend \
             if isinstance(self.backend, ColumnarBackend) else None
+        # whole-wave fusion (accepts+commits, requests+replies — one
+        # engine dispatch per node per wave): a dispatch-tax trade.  On
+        # host XLA a dispatch is ~0.25 ms and the shared-bucket padding
+        # costs more than it saves (measured: knee 4.9K -> 3.2K req/s
+        # fused), so "auto" fuses only when the engine device is a real
+        # accelerator, where every dispatch crosses a link (~70 ms over
+        # this host's tunnel) and halving calls halves the tax.
+        fw = str(Config.get(PC.FUSE_WAVES))
+        self._fuse_waves = self._col_self is not None and (
+            fw == "on" or (fw == "auto" and
+                           self.backend.engine_platform != "cpu"))
         self.table = GroupTable(cap)
         self.logger = PaxosLogger(
             logdir, sync=bool(Config.get(PC.SYNC_WAL)),
@@ -1459,7 +1470,7 @@ class PaxosNode:
         # replies for groups it does, so hoisting replies past accepts
         # cannot reorder same-group work.
         fuse_coord = bool(replies) and (reqs or props or soas) \
-            and self._col_self is not None and self._fused is None
+            and self._fuse_waves
         if fuse_coord:
             t0 = time.monotonic()
             c0 = self._ct()
@@ -1476,7 +1487,7 @@ class PaxosNode:
                 "w.requests", t0,
                 len(reqs) + len(props) + sum(len(s.gkey) for s in soas),
                 cpu_t0=c0)
-        fuse_wave = accepts and commits and self._fused is None
+        fuse_wave = accepts and commits and self._fuse_waves
         if fuse_wave:
             # fused acceptor wave: both types -> ONE device dispatch.
             # Safe to hoist commits past replies: the commit kernel

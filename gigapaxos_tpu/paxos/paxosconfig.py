@@ -46,6 +46,12 @@ class PC(ConfigKey):
     # set "default" there).  The storm/bench path addresses the
     # accelerator directly and is unaffected by this knob.
     COLUMNAR_DEVICE = "cpu"
+    # whole-wave fusion (accepts+commits / requests+replies in one
+    # engine dispatch): "auto" = only on a real accelerator device
+    # (dispatch tax ~70ms/call over a tunnel vs ~0.25ms on host XLA,
+    # where shared-bucket padding outweighs the saved dispatch);
+    # "on"/"off" force it either way
+    FUSE_WAVES = "auto"
     # fused Pallas kernel for the acceptor transition (HOT #1).  CUT
     # from the default path: measured >>10x slower than the XLA scatter
     # path on v5e at every compiling shape (see bench.py pallas probe

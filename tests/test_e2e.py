@@ -151,3 +151,37 @@ def test_client_create_group_api(tmp_path):
             cli.close()
     finally:
         shutdown(nodes)
+
+
+def test_fused_waves_forced_on(tmp_path):
+    """PC.FUSE_WAVES=on routes serving through the whole-wave fused
+    handlers (accepts+commits, requests+replies in one engine dispatch
+    — the on-device configuration) on host XLA, where `auto` would
+    keep the split handlers; replicas must still converge."""
+    Config.set(PC.FUSE_WAVES, "on")
+    nodes, addr_map = make_cluster(tmp_path, backend="columnar")
+    try:
+        assert all(nd._fuse_waves for nd in nodes)
+        for nd in nodes:
+            assert nd.create_group("g0", (0, 1, 2))
+            assert nd.create_group("g1", (0, 1, 2))
+        cli = PaxosClient([addr_map[i] for i in range(3)],
+                          timeout=tscale(15))
+        try:
+            for k in range(40):
+                resp = cli.send_request(f"g{k % 2}", f"rq-{k}".encode())
+                assert resp.status == 0
+            deadline = time.time() + tscale(10)
+            want = {"g0": 20, "g1": 20}
+            while time.time() < deadline:
+                if all(nd.app.count.get(g, 0) == n for nd in nodes
+                       for g, n in want.items()):
+                    break
+                time.sleep(0.05)
+            for g, n in want.items():
+                assert [nd.app.count.get(g) for nd in nodes] == [n] * 3
+                assert len({nd.app.digest.get(g) for nd in nodes}) == 1
+        finally:
+            cli.close()
+    finally:
+        shutdown(nodes)
