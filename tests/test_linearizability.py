@@ -123,11 +123,18 @@ async def _drive(addrs, groups, hist, n_clients, per_client, seed,
             await cli.close()
 
 
-@pytest.mark.parametrize("backend", ["scalar", "native", "columnar"])
+@pytest.mark.parametrize(
+    "backend", ["scalar", "native", "columnar", "columnar-fused"])
 def test_linearizable_under_soup(tmp_path, backend):
     """Loss + coordinator crash + restart + side-group churn, many
     concurrent clients, then assert every group's completed-op history
-    is linearizable."""
+    is linearizable.  `columnar-fused` = PC.FUSE_WAVES=on, the
+    on-device whole-wave configuration."""
+    if backend == "columnar-fused":
+        from gigapaxos_tpu.paxos.paxosconfig import PC
+        from gigapaxos_tpu.utils.config import Config
+        Config.set(PC.FUSE_WAVES, "on")
+        backend = "columnar"
     n = 30 if backend == "scalar" else 60  # oracle engine is slow
     emu = PaxosEmulation(str(tmp_path), n_nodes=3, n_groups=8,
                          backend=backend, app_cls=CounterApp,
