@@ -84,7 +84,10 @@ def capture(bench_budget_s: int) -> dict:
     takes its own cross-process bench_lock.  Outer timeout covers the
     worst case end to end — lock wait (900) + primary (budget) +
     host-XLA fallback (budget) + slack — so we never SIGKILL bench.py
-    mid-flight and orphan its measurement grandchild."""
+    mid-flight and orphan its measurement grandchild.  On success,
+    also capture the ON-DEVICE served path (the row only a healthy
+    accelerator can produce; with PC.FUSE_WAVES=auto it runs the
+    whole-wave fused handlers) into BENCH_ONDEVICE_LAST_GOOD.json."""
     t0 = time.time()
     env = dict(os.environ, GP_BENCH_TIMEOUT_S=str(bench_budget_s),
                GP_BENCH_SKIP_PROBE="1")  # we just probed healthy
@@ -93,11 +96,47 @@ def capture(bench_budget_s: int) -> dict:
             [sys.executable, os.path.join(HERE, "bench.py")],
             capture_output=True,
             timeout=900 + 2 * bench_budget_s + 120, env=env)
-        return {"capture": "bench_rc_%d" % res.returncode,
-                "capture_wall_s": round(time.time() - t0, 1)}
+        rec = {"capture": "bench_rc_%d" % res.returncode,
+               "capture_wall_s": round(time.time() - t0, 1)}
     except subprocess.TimeoutExpired:
         return {"capture": "bench_timeout",
                 "capture_wall_s": round(time.time() - t0, 1)}
+    if rec["capture"] == "bench_rc_0":
+        rec.update(capture_ondevice())
+    return rec
+
+
+def capture_ondevice(timeout_s: int = 900) -> dict:
+    """One bounded on-device columnar e2e run; records the last JSON
+    line (with a recorded_at stamp) to BENCH_ONDEVICE_LAST_GOOD.json
+    when it parses."""
+    t0 = time.time()
+    try:
+        res = subprocess.run(
+            [sys.executable, "-m", "gigapaxos_tpu.testing.main",
+             "throughput", "--backend", "columnar", "--groups", "20000",
+             "--capacity", str(1 << 15), "--requests", "1500",
+             "--concurrency", "128", "--pipeline", "--on-device"],
+            capture_output=True, timeout=timeout_s, cwd=HERE,
+            env=dict(os.environ, GP_BENCH_LOCK_HELD=""))
+        s = res.stdout.decode().strip()
+        line = s.splitlines()[-1] if s else ""
+        if res.returncode == 0 and line.startswith("{"):
+            out = json.loads(line)
+            out["recorded_at"] = time.strftime(
+                "%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+            path = os.path.join(HERE, "BENCH_ONDEVICE_LAST_GOOD.json")
+            tmp = path + f".tmp.{os.getpid()}"
+            with open(tmp, "w") as f:
+                json.dump(out, f)
+            os.replace(tmp, path)
+            return {"ondevice": "ok",
+                    "ondevice_wall_s": round(time.time() - t0, 1)}
+        return {"ondevice": "rc_%d" % res.returncode,
+                "ondevice_wall_s": round(time.time() - t0, 1)}
+    except subprocess.TimeoutExpired:
+        return {"ondevice": "timeout",
+                "ondevice_wall_s": round(time.time() - t0, 1)}
 
 
 def main() -> int:
