@@ -36,8 +36,12 @@ def test_pipelined_worker_e2e(tmp_path, backend):
         # compiles of fresh (op, bucket) specializations land in-window
         stats = emu.run_load(n, concurrency=32, timeout=tscale(40))
         assert stats["ok"] == n, stats
-        # three replicas converge on the same execution count
-        deadline = time.time() + tscale(10)
+        # three replicas converge on the same execution count.
+        # tscale(25): on a cold .jax_cache the straggler's catch-up
+        # commits queue behind fresh kernel compiles (observed: one
+        # replica 5 executions behind at a tscale(10) cutoff, green at
+        # the wider window)
+        deadline = time.time() + tscale(25)
         while time.time() < deadline:
             if len({nd.n_executed for nd in emu.nodes.values()}) == 1:
                 break
