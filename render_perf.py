@@ -69,8 +69,10 @@ def render() -> str:
             f"**{_fmt_k(tpu.get('value'))}/s** median "
             f"({tpu.get('trials')} trials, spread "
             f"{tpu.get('spread')}), **{tpu.get('vs_baseline')}×** the "
-            "C++ per-instance host engine "
-            f"({_fmt_k(i.get('native_baseline_dps'))}/s); step p99 "
+            "C++ per-instance host engine measured in the same window "
+            f"({_fmt_k(i.get('native_baseline_dps'))}/s; the baseline "
+            "itself swings 2-3× across windows on this shared box — "
+            "see BASELINE.md); step p99 "
             f"{tpu.get('p99_ms')} ms at 256K lanes/step; recorded "
             f"{tpu.get('recorded_at')} |")
     else:
