@@ -443,7 +443,8 @@ def run_full(args) -> int:
                 " [FALLBACK on host XLA: accelerator probe " \
                 "wedged/absent]"
         sub("config1_e2e_3r_1k_groups",
-            m + ["throughput", "--requests", "4000" if q else "20000"],
+            m + ["throughput", "--requests", "4000" if q else "20000"]
+            + ([] if q else ["--trials", "3"]),
             300 if q else 420, env=host_cpu_env())
         # config 2 ships TWO rows (round-4 verdict ask #2): the
         # host-XLA KNEE (the operating point: depth auto-tuned to max
