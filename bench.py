@@ -403,6 +403,14 @@ def run_full(args) -> int:
 
     def sub(key, argv, timeout, env=None):
         t0 = time.time()
+        # refresh the lock's mtime per row: bench_lock reclaims locks
+        # stale by >2h, and a full matrix's worst-case child timeouts
+        # sum past that — an un-refreshed mtime would let a concurrent
+        # watcher capture reclaim a LIVE lock mid-matrix
+        try:
+            os.utime(BENCH_LOCK)
+        except OSError:
+            pass
         # children (incl. the config3 bench.py re-entry) must not
         # re-take the lock run_full already holds
         env = dict(env or os.environ, GP_BENCH_LOCK_HELD="1")

@@ -109,18 +109,23 @@ def capture(bench_budget_s: int) -> dict:
 def capture_ondevice(timeout_s: int = 900) -> dict:
     """One bounded on-device columnar e2e run; records the last JSON
     line (with a recorded_at stamp) to BENCH_ONDEVICE_LAST_GOOD.json
-    when it parses."""
+    when it parses.  Holds the cross-process bench lock for the whole
+    measurement — bench.py released it when it exited, and an unlocked
+    15-minute accelerator drive would let a manual bench contend for
+    the chip mid-capture."""
+    from bench import _last_json_line, bench_lock
     t0 = time.time()
     try:
-        res = subprocess.run(
-            [sys.executable, "-m", "gigapaxos_tpu.testing.main",
-             "throughput", "--backend", "columnar", "--groups", "20000",
-             "--capacity", str(1 << 15), "--requests", "1500",
-             "--concurrency", "128", "--pipeline", "--on-device"],
-            capture_output=True, timeout=timeout_s, cwd=HERE,
-            env=dict(os.environ, GP_BENCH_LOCK_HELD=""))
-        s = res.stdout.decode().strip()
-        line = s.splitlines()[-1] if s else ""
+        with bench_lock():
+            res = subprocess.run(
+                [sys.executable, "-m", "gigapaxos_tpu.testing.main",
+                 "throughput", "--backend", "columnar", "--groups",
+                 "20000", "--capacity", str(1 << 15), "--requests",
+                 "1500", "--concurrency", "128", "--pipeline",
+                 "--on-device"],
+                capture_output=True, timeout=timeout_s, cwd=HERE,
+                env=dict(os.environ, GP_BENCH_LOCK_HELD=""))
+        line = _last_json_line(res.stdout)
         if res.returncode == 0 and line.startswith("{"):
             out = json.loads(line)
             out["recorded_at"] = time.strftime(
