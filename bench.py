@@ -465,7 +465,8 @@ def run_full(args) -> int:
                "--groups", "2000" if q else "100000",
                "--capacity", str(1 << 12 if q else 1 << 17),
                "--requests", "1000" if q else "4000",
-               "--concurrency", "448", "--pipeline", "--sweep"]
+               "--concurrency", "448", "--pipeline", "--sweep"] \
+            + ([] if q else ["--trials", "3"])
         sub("config2_columnar_100k_groups_host_xla_knee",
             m + col, 420 if q else 900, env=host_cpu_env())
         # re-probe NOW, not at matrix start: the tunnel can wedge
@@ -514,7 +515,8 @@ def run_full(args) -> int:
             sub(f"config6_hot_group_{eng}",
                 m + ["throughput", "--backend", eng, "--groups", "1",
                      "--requests", "2000" if q else "6000",
-                     "--concurrency", "128", "--sweep"] + extra,
+                     "--concurrency", "128", "--sweep"] + extra
+                + ([] if q else ["--trials", "3"]),
                 300 if q else 500, env=host_cpu_env())
         if not q:
             # the W knob IS the single-group ceiling: the same hot
@@ -523,7 +525,7 @@ def run_full(args) -> int:
             sub("config6b_hot_group_native_w64",
                 m + ["throughput", "--backend", "native", "--groups",
                      "1", "--requests", "6000", "--concurrency", "128",
-                     "--window", "64", "--sweep"],
+                     "--window", "64", "--sweep", "--trials", "3"],
                 500, env=host_cpu_env())
 
     out = {
