@@ -144,13 +144,22 @@ def bench_columnar(G: int, W: int, B: int, iters: int, warmup: int,
     jax.block_until_ready(states[0].bal)
     t_fleet = time.time() - t0
 
-    def step(states):
+    # double-buffered inputs: the host-side RNG + host->device transfer
+    # for step k+1 happen while step k's storm program runs (JAX async
+    # dispatch), so each step's wall is max(device, host-prep) instead
+    # of their sum.  The valid mask is constant — hoisted out entirely.
+    valid = jax.numpy.ones((B,), bool)
+
+    def make_inputs():
         g = jax.numpy.asarray(rng.integers(0, G, B, dtype=np.int32))
         rlo = jax.numpy.asarray(
             rng.integers(0, 1 << 31, B, dtype=np.int32))
         rhi = jax.numpy.asarray(
             rng.integers(0, 1 << 31, B, dtype=np.int32))
-        valid = jax.numpy.ones((B,), bool)
+        return g, rlo, rhi
+
+    def step(states, inputs):
+        g, rlo, rhi = inputs
         return storm(states, g, rlo, rhi, valid)
 
     # Adaptive warmup (round-3 verdict Weak #3: a fixed 2-step warmup
@@ -161,7 +170,7 @@ def bench_columnar(G: int, W: int, B: int, iters: int, warmup: int,
     prev = None
     for i in range(max(12, warmup)):
         t1 = time.perf_counter()
-        states, n = step(states)
+        states, n = step(states, make_inputs())
         n.block_until_ready()
         dt = time.perf_counter() - t1
         if (i + 1 >= warmup and prev is not None
@@ -181,18 +190,25 @@ def bench_columnar(G: int, W: int, B: int, iters: int, warmup: int,
     #    dispatches ~70x on this link (measured 9ms -> 655ms per step),
     #    so per-trial decided counts accumulate ON DEVICE and are
     #    fetched once at the end.
+    # 3. the loop is double-buffered, not free-running: step k is
+    #    dispatched, step k+1's inputs are built (overlapping k's
+    #    device time), then k is SYNCED before its latency is recorded
+    #    — at most one step in flight, so the wall still measures real
+    #    device completions, never the dispatch queue.
     import jax.numpy as jnp
     rates = []
     wall_total = 0.0
     lat_all = []
     trial_counts = []
     trial_walls = []
+    nxt = make_inputs()
     for _ in range(trials):
         lats = []
         tot = jnp.zeros((), jnp.int32)
         for _ in range(iters):
             t0 = time.perf_counter()
-            states, n = step(states)
+            states, n = step(states, nxt)
+            nxt = make_inputs()  # overlaps the in-flight step
             n.block_until_ready()
             lats.append(time.perf_counter() - t0)
             tot = tot + n

@@ -233,10 +233,6 @@ class Transport:
     def send_threadsafe(self, dst: int, frame: bytes) -> None:
         self._loop.call_soon_threadsafe(self.send, dst, frame)
 
-    def send_raw_threadsafe(self, dst: int, buf: bytes,
-                            nframes: int) -> None:
-        self._loop.call_soon_threadsafe(self.send_raw, dst, buf, nframes)
-
     def send_many(self, items: list) -> None:
         """Enqueue ``[(dst, payload, preframed, nframes), ...]`` — ONE
         loop hop for a whole worker batch's sends (each

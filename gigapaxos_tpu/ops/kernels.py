@@ -45,7 +45,10 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
-from gigapaxos_tpu.ops.types import ColumnarState, NO_BALLOT, NO_SLOT
+from gigapaxos_tpu.ops.types import (ACC_BAL, ACC_RHI, ACC_RLO, ACC_SLOT,
+                                     ColumnarState, DEC_SLOT, EMITTED_BIT,
+                                     NO_BALLOT, NO_SLOT, PROP_RHI, PROP_RLO,
+                                     PROP_SLOT, PROP_VOTES, VOTE_MASK)
 
 i32 = jnp.int32
 u32 = jnp.uint32
@@ -117,10 +120,10 @@ def accept_batch(state: ColumnarState, g, slot, bal, rlo, rhi, valid):
 
     w = jnp.where(store, slot % W, 0)
     sgw = _si(g, store, G)
-    acc_bal = state.acc_bal.at[sgw, w].set(bal, mode="drop")
-    acc_slot = state.acc_slot.at[sgw, w].set(slot, mode="drop")
-    acc_req_lo = state.acc_req_lo.at[sgw, w].set(rlo, mode="drop")
-    acc_req_hi = state.acc_req_hi.at[sgw, w].set(rhi, mode="drop")
+    # ONE multi-component scatter for the whole stored pvalue (the
+    # scatter op, not its payload width, is what XLA:CPU serializes on)
+    acc = state.acc.at[sgw, w].set(
+        jnp.stack([slot, bal, rlo, rhi], axis=-1), mode="drop")
 
     out = AcceptOut(
         acked=store | (promised_ok & stale),
@@ -128,10 +131,7 @@ def accept_batch(state: ColumnarState, g, slot, bal, rlo, rhi, valid):
         out_window=promised_ok & ~in_win & ~stale,
         cur_bal=cur_bal,
     )
-    state = state._replace(
-        bal=new_bal, acc_bal=acc_bal, acc_slot=acc_slot,
-        acc_req_lo=acc_req_lo, acc_req_hi=acc_req_hi,
-    )
+    state = state._replace(bal=new_bal, acc=acc)
     return state, out
 
 
@@ -163,23 +163,29 @@ def accept_reply_batch(state: ColumnarState, g, slot, bal, sender, acked,
 
     coord_here = state.is_coord[gi] & state.coord_active[gi]
     is_rel = valid & coord_here & (bal == state.cbal[gi])
+    propc = state.prop[gi, w]  # [B, 4] pre-batch proposal columns
     # slot >= 0 guards against matching uninitialized vote columns
-    # (vote_slot inits to NO_SLOT = -1)
-    match = is_rel & acked & (slot >= 0) & (state.vote_slot[gi, w] == slot)
+    # (PROP_SLOT inits to NO_SLOT = -1)
+    match = is_rel & acked & (slot >= 0) & (propc[:, PROP_SLOT] == slot)
 
-    sender_u = sender.astype(u32)
-    bit = jnp.left_shift(u32(1), sender_u)
-    prev = state.votes[gi, w]
-    fresh = match & (jnp.bitwise_and(jnp.right_shift(prev, sender_u),
-                                     u32(1)) == 0)
+    sender_i = sender.astype(i32)
+    bit = jnp.left_shift(i32(1), sender_i)
+    prev = propc[:, PROP_VOTES]
+    fresh = match & (jnp.bitwise_and(jnp.right_shift(prev, sender_i),
+                                     1) == 0)
     sgw = _si(g, fresh, G)
-    votes = state.votes.at[sgw, w].add(jnp.where(fresh, bit, u32(0)),
-                                       mode="drop")
+    prop = state.prop.at[sgw, w, PROP_VOTES].add(
+        jnp.where(fresh, bit, 0), mode="drop")
 
-    newv = votes[gi, w]
-    cnt = jax.lax.population_count(newv).astype(i32)
+    # re-gather POST-scatter so every lane of a (group, slot) column sees
+    # the whole batch's votes (two fresh votes in one batch must still
+    # cross quorum); `fresh` guarantees no bit is added twice, so the
+    # add never carries into EMITTED_BIT
+    newv = prop[gi, w, PROP_VOTES]
+    cnt = jax.lax.population_count(
+        jnp.bitwise_and(newv, VOTE_MASK)).astype(i32)
     quorum = match & (cnt >= _majority(state.members[gi]))
-    # Exactly-once emission: besides the cross-batch `emitted` flag, dedupe
+    # Exactly-once emission: besides the cross-batch EMITTED_BIT, dedupe
     # WITHIN the batch — when two replies for the same (group, slot) cross
     # quorum in one batch, only the first lane emits the decision.
     # Non-quorum lanes get unique sentinel keys so they never form runs.
@@ -187,25 +193,36 @@ def accept_reply_batch(state: ColumnarState, g, slot, bal, sender, acked,
     iota = jnp.arange(B, dtype=i32)
     dup_before = quorum & (_run_rank(jnp.where(quorum, g, -1),
                                      jnp.where(quorum, slot, iota)) > 0)
-    newly = quorum & ~state.emitted[gi, w] & ~dup_before
-    emitted = state.emitted.at[_si(g, newly, G), w].set(True, mode="drop")
+    emitted_prev = jnp.bitwise_and(prev, EMITTED_BIT) != 0
+    newly = quorum & ~emitted_prev & ~dup_before
+    # `newly` is true at most once per column ever, so the add is an OR
+    prop = prop.at[_si(g, newly, G), w, PROP_VOTES].add(
+        jnp.where(newly, EMITTED_BIT, 0), mode="drop")
 
     # Preemption: a nack carrying a ballot above ours ends our reign
     # (ref: PaxosCoordinator preemption on higher-ballot accept replies).
+    # The resign scatters are guarded by a real branch: preemption is a
+    # failover-window event, and XLA:CPU pays every scatter op as a
+    # serial per-lane loop — two [G] scatters per reply wave for an
+    # almost-always-empty mask was ~8% of the storm step.
     pre = valid & state.is_coord[gi] & ~acked & (bal > state.cbal[gi])
     sp = _si(g, pre, G)
-    is_coord = state.is_coord.at[sp].set(False, mode="drop")
-    coord_active = state.coord_active.at[sp].set(False, mode="drop")
+    is_coord, coord_active = jax.lax.cond(
+        pre.any(),
+        lambda ic, ca: (ic.at[sp].set(False, mode="drop"),
+                        ca.at[sp].set(False, mode="drop")),
+        lambda ic, ca: (ic, ca),
+        state.is_coord, state.coord_active)
 
     out = AcceptReplyOut(
         newly_decided=newly,
         preempted=pre,
         dec_slot=slot,
         dec_bal=state.cbal[gi],
-        req_lo=state.prop_req_lo[gi, w],
-        req_hi=state.prop_req_hi[gi, w],
+        req_lo=propc[:, PROP_RLO],
+        req_hi=propc[:, PROP_RHI],
     )
-    state = state._replace(votes=votes, emitted=emitted, is_coord=is_coord,
+    state = state._replace(prop=prop, is_coord=is_coord,
                            coord_active=coord_active)
     return state, out
 
@@ -249,14 +266,13 @@ def propose_batch(state: ColumnarState, g, rlo, rhi, valid):
     next_slot = state.next_slot.at[sg].add(jnp.where(granted, 1, 0),
                                            mode="drop")
 
-    # initialize the vote column for the assigned slot
+    # initialize the proposal column for the assigned slot: slot, req id,
+    # zero votes/emitted — ONE multi-component scatter
     w = jnp.where(granted, slot % W, 0)
     sgw = _si(g, granted, G)
-    votes = state.votes.at[sgw, w].set(u32(0), mode="drop")
-    vote_slot = state.vote_slot.at[sgw, w].set(slot, mode="drop")
-    prop_req_lo = state.prop_req_lo.at[sgw, w].set(rlo, mode="drop")
-    prop_req_hi = state.prop_req_hi.at[sgw, w].set(rhi, mode="drop")
-    emitted = state.emitted.at[sgw, w].set(False, mode="drop")
+    prop = state.prop.at[sgw, w].set(
+        jnp.stack([slot, rlo, rhi, jnp.zeros_like(slot)], axis=-1),
+        mode="drop")
 
     out = ProposeOut(
         granted=granted,
@@ -266,9 +282,7 @@ def propose_batch(state: ColumnarState, g, rlo, rhi, valid):
         slot=slot,
         cbal=state.cbal[gi],
     )
-    state = state._replace(next_slot=next_slot, votes=votes,
-                           vote_slot=vote_slot, prop_req_lo=prop_req_lo,
-                           prop_req_hi=prop_req_hi, emitted=emitted)
+    state = state._replace(next_slot=next_slot, prop=prop)
     return state, out
 
 
@@ -296,19 +310,17 @@ def commit_batch(state: ColumnarState, g, slot, rlo, rhi, valid):
     w = jnp.where(store, slot % W, 0)
     sgw = _si(g, store, G)
 
-    dec = state.dec.at[sgw, w].set(True, mode="drop")
-    dec_slot = state.dec_slot.at[sgw, w].set(slot, mode="drop")
-    dec_req_lo = state.dec_req_lo.at[sgw, w].set(rlo, mode="drop")
-    dec_req_hi = state.dec_req_hi.at[sgw, w].set(rhi, mode="drop")
+    # ONE multi-component scatter; "decided" is DEC_SLOT == expected slot
+    # (NO_SLOT never matches), so no separate flag plane exists
+    dec = state.dec.at[sgw, w].set(
+        jnp.stack([slot, rlo, rhi], axis=-1), mode="drop")
 
     # contiguity advance over the touched rows only ([B, W] gathers)
-    decr = dec[gi]
-    dslotr = dec_slot[gi]
+    dslotr = dec[gi, :, DEC_SLOT]
     k = jnp.arange(W, dtype=i32)[None, :]
     want = cursor[:, None] + k
     col = want % W
-    ok = jnp.take_along_axis(decr, col, axis=1) & \
-        (jnp.take_along_axis(dslotr, col, axis=1) == want)
+    ok = jnp.take_along_axis(dslotr, col, axis=1) == want
     adv = jnp.sum(jnp.cumprod(ok.astype(i32), axis=1), axis=1)
     new_cur = cursor + adv
 
@@ -321,9 +333,7 @@ def commit_batch(state: ColumnarState, g, slot, rlo, rhi, valid):
         out_window=valid & act & (slot >= cursor + W),
         new_cursor=exec_cursor[gi],
     )
-    state = state._replace(dec=dec, dec_slot=dec_slot,
-                           dec_req_lo=dec_req_lo, dec_req_hi=dec_req_hi,
-                           exec_cursor=exec_cursor)
+    state = state._replace(dec=dec, exec_cursor=exec_cursor)
     return state, out
 
 
@@ -356,14 +366,15 @@ def prepare_batch(state: ColumnarState, g, bal, valid):
     cur_bal = new_bal[gi]
     acked = live & (bal >= cur_bal)
 
+    accr = state.acc[gi]  # [B, W, 4]
     out = PrepareOut(
         acked=acked,
         cur_bal=cur_bal,
         exec_cursor=state.exec_cursor[gi],
-        win_slot=state.acc_slot[gi],
-        win_bal=state.acc_bal[gi],
-        win_req_lo=state.acc_req_lo[gi],
-        win_req_hi=state.acc_req_hi[gi],
+        win_slot=accr[..., ACC_SLOT],
+        win_bal=accr[..., ACC_BAL],
+        win_req_lo=accr[..., ACC_RLO],
+        win_req_hi=accr[..., ACC_RHI],
     )
     return state._replace(bal=new_bal), out
 
@@ -395,16 +406,13 @@ def install_coordinator_batch(state: ColumnarState, g, cbal, next_slot,
     has = valid[:, None] & (carry_slot >= 0)
     w = jnp.where(has, carry_slot % W, 0)
     sg = jnp.where(has, g[:, None], G)
-    votes = state.votes.at[sg, w].set(u32(0), mode="drop")
-    vote_slot = state.vote_slot.at[sg, w].set(carry_slot, mode="drop")
-    prop_req_lo = state.prop_req_lo.at[sg, w].set(carry_rlo, mode="drop")
-    prop_req_hi = state.prop_req_hi.at[sg, w].set(carry_rhi, mode="drop")
-    emitted = state.emitted.at[sg, w].set(False, mode="drop")
+    prop = state.prop.at[sg, w].set(
+        jnp.stack([carry_slot, carry_rlo, carry_rhi,
+                   jnp.zeros_like(carry_slot)], axis=-1), mode="drop")
 
     state = state._replace(
         is_coord=is_coord, coord_active=coord_active, cbal=cbal_arr,
-        next_slot=ns, votes=votes, vote_slot=vote_slot,
-        prop_req_lo=prop_req_lo, prop_req_hi=prop_req_hi, emitted=emitted,
+        next_slot=ns, prop=prop,
     )
     return state, None
 
@@ -427,24 +435,19 @@ def create_groups_batch(state: ColumnarState, rows, members, version,
     G, W = state.G, state.W
     si = _si(rows, valid, G)
     vT = valid
-    zW = jnp.zeros((rows.shape[0], W), i32)
-    nW = jnp.full((rows.shape[0], W), NO_SLOT, i32)
-    bW = jnp.full((rows.shape[0], W), NO_BALLOT, i32)
-    fW = jnp.zeros((rows.shape[0], W), jnp.bool_)
+    B = rows.shape[0]
+
+    def plane(cols):
+        return jnp.broadcast_to(jnp.asarray(cols, i32), (B, W, len(cols)))
 
     state = state._replace(
         active=state.active.at[si].set(True, mode="drop"),
         members=state.members.at[si].set(members, mode="drop"),
         version=state.version.at[si].set(version, mode="drop"),
         bal=state.bal.at[si].set(init_bal, mode="drop"),
-        acc_bal=state.acc_bal.at[si].set(bW, mode="drop"),
-        acc_slot=state.acc_slot.at[si].set(nW, mode="drop"),
-        acc_req_lo=state.acc_req_lo.at[si].set(zW, mode="drop"),
-        acc_req_hi=state.acc_req_hi.at[si].set(zW, mode="drop"),
-        dec=state.dec.at[si].set(fW, mode="drop"),
-        dec_slot=state.dec_slot.at[si].set(nW, mode="drop"),
-        dec_req_lo=state.dec_req_lo.at[si].set(zW, mode="drop"),
-        dec_req_hi=state.dec_req_hi.at[si].set(zW, mode="drop"),
+        acc=state.acc.at[si].set(plane([NO_SLOT, NO_BALLOT, 0, 0]),
+                                 mode="drop"),
+        dec=state.dec.at[si].set(plane([NO_SLOT, 0, 0]), mode="drop"),
         exec_cursor=state.exec_cursor.at[si].set(0, mode="drop"),
         gc_slot=state.gc_slot.at[si].set(NO_SLOT, mode="drop"),
         is_coord=state.is_coord.at[si].set(vT & self_coord, mode="drop"),
@@ -454,11 +457,8 @@ def create_groups_batch(state: ColumnarState, rows, members, version,
                                              NO_BALLOT), mode="drop"),
         next_slot=state.next_slot.at[si].set(0, mode="drop"),
         prep_votes=state.prep_votes.at[si].set(u32(0), mode="drop"),
-        votes=state.votes.at[si].set(jnp.zeros_like(zW, u32), mode="drop"),
-        vote_slot=state.vote_slot.at[si].set(nW, mode="drop"),
-        prop_req_lo=state.prop_req_lo.at[si].set(zW, mode="drop"),
-        prop_req_hi=state.prop_req_hi.at[si].set(zW, mode="drop"),
-        emitted=state.emitted.at[si].set(fW, mode="drop"),
+        prop=state.prop.at[si].set(plane([NO_SLOT, 0, 0, 0]),
+                                   mode="drop"),
     )
     return state, None
 

@@ -3,7 +3,8 @@
 Reference analog: ``PaxosAcceptor.acceptAndUpdateBallot`` — the
 ballot-compare + window-store transition that every AcceptPacket hits
 (SURVEY.md §3.1).  The XLA path (``kernels.accept_batch``) expresses it
-as 5 separate scatter ops over the ``[G, W]`` state; this kernel fuses
+as a ballot scatter-max plus one multi-component scatter into the
+packed ``[G, W, 4]`` acc plane; this kernel fuses
 the whole transition into ONE pass that DMAs each touched 8-row block
 to VMEM once, applies every lane aimed at it, and writes it back.
 
@@ -49,7 +50,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from gigapaxos_tpu.ops.types import NO_BALLOT, NO_SLOT, ColumnarState
+from gigapaxos_tpu.ops.types import (ACC_BAL, ACC_RHI, ACC_RLO, ACC_SLOT,
+                                     NO_BALLOT, NO_SLOT, ColumnarState)
 
 i32 = jnp.int32
 SUB = 8  # octile height; Mosaic's sublane granule for i32
@@ -264,6 +266,11 @@ class PallasAccept:
 
             blocks_p = np.pad(blocks_u.astype(np.int32), (0, pad_r),
                               constant_values=pad_block)
+            # unpack the acc plane to the kernel's per-component arrays
+            # (slices at the jit boundary; the packed layout exists for
+            # the XLA scatter path's sake — this opt-in kernel pays the
+            # split/restack instead)
+            acc = state.acc
             new = _accept_blocks(
                 jnp.asarray(blocks_p), state.bal, state.active,
                 state.exec_cursor, jnp.asarray(lanes(slot, NO_SLOT)),
@@ -271,12 +278,11 @@ class PallasAccept:
                 jnp.asarray(lanes(rlo, 0)), jnp.asarray(lanes(rhi, 0)),
                 jnp.asarray(lanes(np.asarray(g) % SUB, 0)),
                 jnp.asarray(lanes(np.ones(B, np.int32), 0)),
-                state.acc_bal, state.acc_slot,
-                state.acc_req_lo, state.acc_req_hi, self.interpret)
+                acc[:, :, ACC_BAL], acc[:, :, ACC_SLOT],
+                acc[:, :, ACC_RLO], acc[:, :, ACC_RHI], self.interpret)
             bal_n, abal_n, aslot_n, alo_n, ahi_n, lane_out = new
-            state = state._replace(bal=bal_n, acc_bal=abal_n,
-                                   acc_slot=aslot_n, acc_req_lo=alo_n,
-                                   acc_req_hi=ahi_n)
+            state = state._replace(bal=bal_n, acc=jnp.stack(
+                [aslot_n, abal_n, alo_n, ahi_n], axis=-1))
             lo = np.asarray(lane_out)[:R].reshape(R, 4, self.L)
             live = ~padded.reshape(R, self.L)
             flat = lane_index.reshape(-1)[live.reshape(-1)]

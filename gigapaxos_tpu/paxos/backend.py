@@ -21,12 +21,16 @@ builds them straight from decoded struct-of-arrays packets.
 from __future__ import annotations
 
 import abc
-from typing import Dict, List, NamedTuple, Optional, Tuple
+import contextlib
+import threading
+import time
+from typing import Callable, Dict, List, NamedTuple, Optional, Tuple
 
 import numpy as np
 
 from gigapaxos_tpu.ops.oracle import OracleGroup, PValue, make_oracle_group
 from gigapaxos_tpu.ops.types import NO_BALLOT, NO_SLOT
+from gigapaxos_tpu.utils.profiler import DelayProfiler
 
 
 class AcceptRes(NamedTuple):
@@ -418,17 +422,91 @@ class NativeBackend(AcceptorBackend):
 # --------------------------------------------------------------------------
 
 
+_BUCKET_CAP = 4096  # largest jit bucket; bigger batches dispatch chunked
+
+
 def _bucket(n: int, lo: int = 8) -> int:
-    """Smallest 8**k * lo >= n.  Coarse on purpose: each (op, bucket)
-    pair is one jit specialization, and at serving capacity a single
-    compile costs ~10-20s of one-core wall — a x2 ladder was paying
-    that up to 7 times per op mid-measurement.  A x8 ladder caps the
-    runtime ladder at {8, 64, 512, 4096} while the padding it adds is
-    vectorized-lane work measured in microseconds."""
+    """Smallest 8**k * lo >= n, CLAMPED at ``_BUCKET_CAP``.  Coarse on
+    purpose: each (op, bucket) pair is one jit specialization, and at
+    serving capacity a single compile costs ~10-20s of one-core wall —
+    a x2 ladder was paying that up to 7 times per op mid-measurement.
+    The x8 ladder is exactly {8, 64, 512, 4096}, and the clamp closes
+    the ladder: a 4097-item batch used to pad 8x to 32768 and trigger a
+    fresh multi-second compile mid-serving; now every caller splits such
+    batches into <=4096-lane chunks (:func:`_chunks`), so the compile
+    set is finite and fully warmable."""
     b = lo
-    while b < n:
+    while b < n and b < _BUCKET_CAP:
         b <<= 3
     return b
+
+
+def _chunks(n: int) -> List[Tuple[int, int]]:
+    """[lo, hi) slices of at most ``_BUCKET_CAP`` lanes covering ``n``
+    (a single slice for small batches; ``[(0, 0)]`` for empty input so
+    fused callers still get a lane-aligned dispatch)."""
+    if n <= _BUCKET_CAP:
+        return [(0, n)]
+    return [(at, min(at + _BUCKET_CAP, n))
+            for at in range(0, n, _BUCKET_CAP)]
+
+
+# One sharded program at a time per PROCESS on a virtual CPU mesh:
+# XLA:CPU collectives rendezvous all mesh partitions on a small thread
+# pool, and when several nodes of an in-process emulation dispatch
+# sharded programs concurrently the rendezvous thrash ("has been
+# waiting 5000ms" stalls) slows every wave by orders of magnitude
+# (observed: a 20-request load that completes in ~2s serialized never
+# finishing at all interleaved).  Real deployments run one node per
+# process — and a real accelerator mesh has per-chip cores — so the
+# guard applies ONLY to cpu-platform meshes.
+_CPU_MESH_DISPATCH_LOCK = threading.Lock()
+
+
+class EngineWave:
+    """Handle for an in-flight engine wave (the submit half of a
+    submit/collect pair).  ``collect()`` blocks until the device
+    results are host-resident and returns the op's result tuple; call
+    it exactly once.  The submit already launched the jit call(s) and
+    started the device->host copies, so the wall spent inside
+    ``collect`` is pure blocked-on-device time — recorded under the
+    ``eng.collect`` DelayProfiler total, with the submit->collect gap
+    (the overlap the caller actually won) under ``eng.overlap``."""
+
+    __slots__ = ("_finish", "_n", "_submitted")
+
+    def __init__(self, finish: Callable, n: int):
+        self._finish = finish
+        self._n = n
+        self._submitted = time.monotonic()
+
+    def collect(self):
+        t0 = time.monotonic()
+        DelayProfiler.add_total("eng.overlap", t0 - self._submitted,
+                                self._n)
+        res = self._finish()
+        DelayProfiler.update_total("eng.collect", t0, self._n)
+        return res
+
+
+def _d2h_start(out) -> None:
+    """Begin the async device->host copy of a kernel output (JAX async
+    dispatch); a backend without the method just materializes later."""
+    try:
+        out.copy_to_host_async()
+    except AttributeError:
+        pass
+
+
+def _collect_cols(outs: List[Tuple[object, int]]) -> np.ndarray:
+    """Materialize chunked [k, bucket] device outputs into one host
+    [k, n] array (single-chunk fast path skips the concatenate)."""
+    parts = [np.asarray(o)[:, :m] for o, m in outs if m]
+    if len(parts) == 1:
+        return parts[0]
+    if not parts:  # zero live lanes: keep the [k, 0] shape
+        return np.asarray(outs[0][0])[:, :0]
+    return np.concatenate(parts, axis=1)
 
 
 class ColumnarBackend(AcceptorBackend):
@@ -450,7 +528,10 @@ class ColumnarBackend(AcceptorBackend):
         # warm compiles for every process after the first: the packed
         # kernels at serving capacity take ~10-20s EACH to compile on a
         # one-core host, and without the persistent cache the node pays
-        # that mid-measurement for every (op, bucket) specialization
+        # that mid-measurement for every (op, bucket) specialization.
+        # Idempotent (module-level once-flag in jaxcache): constructing
+        # a second backend must not silently repoint the process-global
+        # jax cache config.
         enable_persistent_cache()
         self._jax = jax
         self._k = kernels
@@ -518,6 +599,10 @@ class ColumnarBackend(AcceptorBackend):
             # the octile kernel requires G % 8 == 0 (a partial last
             # octile would let grid padding alias a real one)
             use_pallas_accept = False
+        # see _CPU_MESH_DISPATCH_LOCK: serialize sharded host-XLA
+        # programs across an in-process multi-node emulation
+        self._serialize_dispatch = (self._mesh is not None
+                                    and devs[0].platform == "cpu")
         if use_pallas_accept:
             try:
                 from gigapaxos_tpu.ops.pallas_accept import PallasAccept
@@ -599,31 +684,82 @@ class ColumnarBackend(AcceptorBackend):
         the valid mask as the last row — a single host->device transfer
         per kernel call (link round trips dominate small batches).
         ``bucket`` lets multi-input fused calls share one padded size so
-        their jit cache stays bounded by the ladder, not its square."""
+        their jit cache stays bounded by the ladder, not its square.
+
+        The buffer is a fresh ``np.empty`` per wave, fully overwritten
+        (live lanes + padding tail) — that keeps the old np.zeros'
+        memset off the hot path WITHOUT reusing buffers.  Reuse rings
+        were tried and are unsound here: ``jnp.asarray`` on XLA:CPU
+        zero-copies (the device array aliases this numpy buffer) and
+        dispatch is asynchronous, so a wave deep enough to wrap any
+        fixed-depth ring would overwrite an in-flight chunk's input."""
         b = bucket or _bucket(n)
-        out = np.zeros((len(cols) + 1, b), np.int32)
+        out = np.empty((len(cols) + 1, b), np.int32)
         for i, (col, fill) in enumerate(cols):
-            if fill:
-                out[i, n:] = fill
-            out[i, :n] = np.asarray(col).astype(np.int32, copy=False)
+            row = out[i]
+            row[:n] = col
+            row[n:] = fill
         out[len(cols), :n] = 1  # valid mask
+        out[len(cols), n:] = 0
         return self._dev(out)
+
+    def _disp(self):
+        """Dispatch guard: the process-wide one-sharded-program-at-a-
+        time lock on virtual cpu meshes, a no-op everywhere else."""
+        if self._serialize_dispatch:
+            return _CPU_MESH_DISPATCH_LOCK
+        return contextlib.nullcontext()
+
+    def _submit1(self, kern, n, cols) -> List[Tuple[object, int]]:
+        """Launch a packed kernel over <=``_BUCKET_CAP``-lane chunks
+        (the bucket-ladder clamp) and start every chunk output's async
+        device->host copy; returns the chunk list for _collect_cols.
+        Chunks apply sequentially, which is a per-chunk linearization —
+        safe for paxos exactly like the batch linearization (kernels.py
+        determinism note), and what the scalar engines do per item."""
+        t0 = time.monotonic()
+        cols = [(np.asarray(c), f) for c, f in cols]
+        outs = []
+        for a, bnd in _chunks(n):
+            m = bnd - a
+            with self._disp():
+                self.state, o = kern(self.state, self._packed(
+                    m, *[(c[a:bnd], f) for c, f in cols]))
+            _d2h_start(o)
+            outs.append((o, m))
+        DelayProfiler.update_total("eng.submit", t0, n)
+        return outs
 
     # -- ops ---------------------------------------------------------------
 
     def create(self, rows, members, versions, init_bal, self_coord):
-        n = len(rows)
-        self.state, _ = self._k.create_groups(
-            self.state, self._pad1(rows, 0), self._pad1(members, 1),
-            self._pad1(versions, 0), self._pad1(init_bal, NO_BALLOT),
-            self._pad1(self_coord, False, bool), self._valid(n))
+        rows, members = np.asarray(rows), np.asarray(members)
+        versions, init_bal = np.asarray(versions), np.asarray(init_bal)
+        self_coord = np.asarray(self_coord)
+        for a, b in _chunks(len(rows)):
+            m = b - a
+            with self._disp():
+                self.state, _ = self._k.create_groups(
+                    self.state, self._pad1(rows[a:b], 0),
+                    self._pad1(members[a:b], 1),
+                    self._pad1(versions[a:b], 0),
+                    self._pad1(init_bal[a:b], NO_BALLOT),
+                    self._pad1(self_coord[a:b], False, bool),
+                    self._valid(m))
 
     def delete(self, rows):
-        n = len(rows)
-        self.state, _ = self._k.delete_groups(
-            self.state, self._pad1(rows, 0), self._valid(n))
+        rows = np.asarray(rows)
+        for a, b in _chunks(len(rows)):
+            with self._disp():
+                self.state, _ = self._k.delete_groups(
+                    self.state, self._pad1(rows[a:b], 0),
+                    self._valid(b - a))
 
-    def accept(self, rows, slots, bals, req_ids) -> AcceptRes:
+    def accept_submit(self, rows, slots, bals, req_ids) -> EngineWave:
+        """Non-blocking accept wave: launches the jit call(s) and the
+        device->host output copy, returning an :class:`EngineWave` whose
+        ``collect()`` yields the :class:`AcceptRes`.  The blocking
+        :meth:`accept` is this submit + an immediate collect."""
         n = len(rows)
         lo, hi = _split64(req_ids)
         if self._pallas is not None:
@@ -631,90 +767,147 @@ class ColumnarBackend(AcceptorBackend):
                 self.state, np.asarray(rows, np.int32),
                 np.asarray(slots, np.int32), np.asarray(bals, np.int32),
                 lo, hi, np.ones(n, bool))
-            return AcceptRes(acked, stale, ow, cur_bal)
-        self.state, o = self._k.accept_p(self.state, self._packed(
-            n, (rows, 0), (slots, NO_SLOT), (bals, NO_BALLOT), (lo, 0),
-            (hi, 0)))
-        out = np.asarray(o)[:, :n]
-        return AcceptRes(out[0] != 0, out[1] != 0, out[2] != 0, out[3])
+            res = AcceptRes(acked, stale, ow, cur_bal)
+            return EngineWave(lambda: res, n)
+        outs = self._submit1(self._k.accept_p, n, [
+            (rows, 0), (slots, NO_SLOT), (bals, NO_BALLOT), (lo, 0),
+            (hi, 0)])
+
+        def finish():
+            out = _collect_cols(outs)
+            return AcceptRes(out[0] != 0, out[1] != 0, out[2] != 0,
+                             out[3])
+        return EngineWave(finish, n)
+
+    def accept(self, rows, slots, bals, req_ids) -> AcceptRes:
+        return self.accept_submit(rows, slots, bals, req_ids).collect()
+
+    def accept_reply_submit(self, rows, slots, bals, senders, acked
+                            ) -> EngineWave:
+        n = len(rows)
+        outs = self._submit1(self._k.accept_reply_p, n, [
+            (rows, 0), (slots, NO_SLOT), (bals, NO_BALLOT),
+            (senders, 0), (np.asarray(acked, np.int32), 0)])
+
+        def finish():
+            out = _collect_cols(outs)
+            newly = out[0] != 0
+            # decision fields only meaningful on newly-decided lanes
+            return AcceptReplyRes(
+                newly, out[1] != 0, np.where(newly, out[3], 0),
+                np.where(newly, out[4], 0),
+                np.where(newly, out[2], NO_BALLOT))
+        return EngineWave(finish, n)
 
     def accept_reply(self, rows, slots, bals, senders, acked
                      ) -> AcceptReplyRes:
-        n = len(rows)
-        self.state, o = self._k.accept_reply_p(self.state, self._packed(
-            n, (rows, 0), (slots, NO_SLOT), (bals, NO_BALLOT),
-            (senders, 0), (np.asarray(acked, np.int32), 0)))
-        out = np.asarray(o)[:, :n]
-        newly = out[0] != 0
-        # decision fields only meaningful on newly-decided lanes
-        return AcceptReplyRes(
-            newly, out[1] != 0, np.where(newly, out[3], 0),
-            np.where(newly, out[4], 0),
-            np.where(newly, out[2], NO_BALLOT))
+        return self.accept_reply_submit(rows, slots, bals, senders,
+                                        acked).collect()
 
     def propose(self, rows, req_ids) -> ProposeRes:
         n = len(rows)
         lo, hi = _split64(req_ids)
-        self.state, o = self._k.propose_p(self.state, self._packed(
-            n, (rows, 0), (lo, 0), (hi, 0)))
-        out = np.asarray(o)[:, :n]
+        outs = self._submit1(self._k.propose_p, n, [
+            (rows, 0), (lo, 0), (hi, 0)])
+        out = _collect_cols(outs)
         granted = out[0] != 0
         return ProposeRes(granted, out[1] != 0, out[2] != 0,
                           np.where(granted, out[3], NO_SLOT), out[4])
 
-    def commit(self, rows, slots, req_ids) -> CommitRes:
+    def commit_submit(self, rows, slots, req_ids) -> EngineWave:
         n = len(rows)
         lo, hi = _split64(req_ids)
-        self.state, o = self._k.commit_p(self.state, self._packed(
-            n, (rows, 0), (slots, NO_SLOT), (lo, 0), (hi, 0)))
-        out = np.asarray(o)[:, :n]
-        return CommitRes(out[0] != 0, out[1] != 0, out[2] != 0, out[3])
+        outs = self._submit1(self._k.commit_p, n, [
+            (rows, 0), (slots, NO_SLOT), (lo, 0), (hi, 0)])
+
+        def finish():
+            out = _collect_cols(outs)
+            return CommitRes(out[0] != 0, out[1] != 0, out[2] != 0,
+                             out[3])
+        return EngineWave(finish, n)
+
+    def commit(self, rows, slots, req_ids) -> CommitRes:
+        return self.commit_submit(rows, slots, req_ids).collect()
+
+    def _submit2(self, kern, n1, cols1, n2, cols2):
+        """Dual-input fused dispatch, chunked like :meth:`_submit1`
+        with BOTH inputs sharing one bucket per chunk (bounds the
+        composed kernel's jit cache to the ladder, not its square)."""
+        t0 = time.monotonic()
+        cols1 = [(np.asarray(c), f) for c, f in cols1]
+        cols2 = [(np.asarray(c), f) for c, f in cols2]
+        outs1, outs2 = [], []
+        for a, bnd in _chunks(max(n1, n2)):
+            a1, b1 = min(a, n1), min(bnd, n1)
+            a2, b2 = min(a, n2), min(bnd, n2)
+            b = _bucket(max(b1 - a1, b2 - a2))
+            with self._disp():
+                self.state, o1, o2 = kern(
+                    self.state,
+                    self._packed(b1 - a1,
+                                 *[(c[a1:b1], f) for c, f in cols1],
+                                 bucket=b),
+                    self._packed(b2 - a2,
+                                 *[(c[a2:b2], f) for c, f in cols2],
+                                 bucket=b))
+            _d2h_start(o1)
+            _d2h_start(o2)
+            outs1.append((o1, b1 - a1))
+            outs2.append((o2, b2 - a2))
+        DelayProfiler.update_total("eng.submit", t0, n1 + n2)
+        return outs1, outs2
+
+    def accept_commit_submit(self, rows_a, slots_a, bals_a, reqs_a,
+                             rows_c, slots_c, reqs_c) -> EngineWave:
+        """ONE device dispatch per chunk for the acceptor wave (accepts
+        then commits — `kernels.accept_commit_packed`).  Dispatch
+        overhead, not kernel time, dominates runtime batches (~0.2-0.3
+        ms/call warm), so halving the acceptor's calls is a direct
+        latency-path win."""
+        na, nc = len(rows_a), len(rows_c)
+        if self._pallas is not None:
+            # the Pallas accept path owns accepts; keep the calls split
+            res = AcceptorBackend.accept_commit(
+                self, rows_a, slots_a, bals_a, reqs_a, rows_c, slots_c,
+                reqs_c)
+            return EngineWave(lambda: res, na + nc)
+        lo_a, hi_a = _split64(reqs_a)
+        lo_c, hi_c = _split64(reqs_c)
+        outs_a, outs_c = self._submit2(
+            self._k.accept_commit_p,
+            na, [(rows_a, 0), (slots_a, NO_SLOT), (bals_a, NO_BALLOT),
+                 (lo_a, 0), (hi_a, 0)],
+            nc, [(rows_c, 0), (slots_c, NO_SLOT), (lo_c, 0),
+                 (hi_c, 0)])
+
+        def finish():
+            a = _collect_cols(outs_a)
+            c = _collect_cols(outs_c)
+            return (AcceptRes(a[0] != 0, a[1] != 0, a[2] != 0, a[3]),
+                    CommitRes(c[0] != 0, c[1] != 0, c[2] != 0, c[3]))
+        return EngineWave(finish, na + nc)
 
     def accept_commit(self, rows_a, slots_a, bals_a, reqs_a,
                       rows_c, slots_c, reqs_c
                       ) -> Tuple[AcceptRes, CommitRes]:
-        """ONE device dispatch for the acceptor wave (accepts then
-        commits — `kernels.accept_commit_packed`).  Dispatch overhead,
-        not kernel time, dominates runtime batches (~0.2-0.3 ms/call
-        warm), so halving the acceptor's calls is a direct latency-path
-        win.  Shared bucket keeps the composed kernel's jit cache at
-        one entry per ladder rung."""
-        if self._pallas is not None:
-            # the Pallas accept path owns accepts; keep the calls split
-            return super().accept_commit(rows_a, slots_a, bals_a,
+        return self.accept_commit_submit(rows_a, slots_a, bals_a,
                                          reqs_a, rows_c, slots_c,
-                                         reqs_c)
-        na, nc = len(rows_a), len(rows_c)
-        b = _bucket(max(na, nc))
-        lo_a, hi_a = _split64(reqs_a)
-        lo_c, hi_c = _split64(reqs_c)
-        self.state, ao, co = self._k.accept_commit_p(
-            self.state,
-            self._packed(na, (rows_a, 0), (slots_a, NO_SLOT),
-                         (bals_a, NO_BALLOT), (lo_a, 0), (hi_a, 0),
-                         bucket=b),
-            self._packed(nc, (rows_c, 0), (slots_c, NO_SLOT),
-                         (lo_c, 0), (hi_c, 0), bucket=b))
-        a = np.asarray(ao)[:, :na]
-        c = np.asarray(co)[:, :nc]
-        return (AcceptRes(a[0] != 0, a[1] != 0, a[2] != 0, a[3]),
-                CommitRes(c[0] != 0, c[1] != 0, c[2] != 0, c[3]))
+                                         reqs_c).collect()
 
     def accept_reply_commit_self(self, rows, slots, bals, senders, acked
                                  ) -> Tuple[AcceptReplyRes, np.ndarray,
                                             np.ndarray]:
-        """Fused reply + own commit (ONE device call; see
+        """Fused reply + own commit (ONE device call per chunk; see
         kernels.accept_reply_commit_self_packed).  Returns
         (AcceptReplyRes, applied[B], stale[B]) — the extra columns are
         the coordinator's own commit result for newly-decided lanes
         (execution is re-derived host-side from the decision dict, so
         the device cursor is not surfaced)."""
         n = len(rows)
-        self.state, o = self._k.accept_reply_commit_self_p(
-            self.state, self._packed(
-                n, (rows, 0), (slots, NO_SLOT), (bals, NO_BALLOT),
-                (senders, 0), (np.asarray(acked, np.int32), 0)))
-        out = np.asarray(o)[:, :n]
+        outs = self._submit1(self._k.accept_reply_commit_self_p, n, [
+            (rows, 0), (slots, NO_SLOT), (bals, NO_BALLOT),
+            (senders, 0), (np.asarray(acked, np.int32), 0)])
+        out = _collect_cols(outs)
         newly = out[0] != 0
         res = AcceptReplyRes(
             newly, out[1] != 0, np.where(newly, out[3], 0),
@@ -723,58 +916,73 @@ class ColumnarBackend(AcceptorBackend):
         return res, out[6] != 0, out[7] != 0
 
     def propose_self(self, rows, req_ids, self_midx):
-        """Fused propose + own accept + own vote (ONE device call; see
-        kernels.propose_accept_self_packed).  Returns (ProposeRes,
-        self_acked[B], newly_decided[B], preempted[B], acc_cur_bal[B])
-        — the last two surface what the loopback self-wave's nack reply
-        used to carry."""
+        """Fused propose + own accept + own vote (ONE device call per
+        chunk; see kernels.propose_accept_self_packed).  Returns
+        (ProposeRes, self_acked[B], newly_decided[B], preempted[B],
+        acc_cur_bal[B]) — the last two surface what the loopback
+        self-wave's nack reply used to carry."""
         n = len(rows)
         lo, hi = _split64(req_ids)
-        self.state, o = self._k.propose_accept_self_p(
-            self.state, self._packed(
-                n, (rows, 0), (lo, 0), (hi, 0), (self_midx, 0)))
-        out = np.asarray(o)[:, :n]
+        outs = self._submit1(self._k.propose_accept_self_p, n, [
+            (rows, 0), (lo, 0), (hi, 0), (self_midx, 0)])
+        out = _collect_cols(outs)
         granted = out[0] != 0
         pr = ProposeRes(granted, out[1] != 0, out[2] != 0,
                         np.where(granted, out[3], NO_SLOT), out[4])
         return pr, out[5] != 0, out[6] != 0, out[7] != 0, out[8]
 
+    def propose_self_reply_submit(self, rows_p, reqs_p, self_midx,
+                                  rows_r, slots_r, bals_r, senders_r,
+                                  acked_r) -> EngineWave:
+        """Fused coordinator wave (ONE device call per chunk;
+        kernels.request_reply_p): new proposals + accept replies of the
+        same worker batch.  ``collect()`` returns what
+        :meth:`propose_self` and :meth:`accept_reply_commit_self`
+        return, as a pair."""
+        np_, nr = len(rows_p), len(rows_r)
+        lo_p, hi_p = _split64(reqs_p)
+        outs_p, outs_r = self._submit2(
+            self._k.request_reply_p,
+            np_, [(rows_p, 0), (lo_p, 0), (hi_p, 0), (self_midx, 0)],
+            nr, [(rows_r, 0), (slots_r, NO_SLOT), (bals_r, NO_BALLOT),
+                 (senders_r, 0), (np.asarray(acked_r, np.int32), 0)])
+
+        def finish():
+            p = _collect_cols(outs_p)
+            r = _collect_cols(outs_r)
+            granted = p[0] != 0
+            pres = (ProposeRes(granted, p[1] != 0, p[2] != 0,
+                               np.where(granted, p[3], NO_SLOT), p[4]),
+                    p[5] != 0, p[6] != 0, p[7] != 0, p[8])
+            newly = r[0] != 0
+            rres = (AcceptReplyRes(
+                newly, r[1] != 0, np.where(newly, r[3], 0),
+                np.where(newly, r[4], 0),
+                np.where(newly, r[2], NO_BALLOT)), r[6] != 0, r[7] != 0)
+            return pres, rres
+        return EngineWave(finish, np_ + nr)
+
     def propose_self_reply(self, rows_p, reqs_p, self_midx,
                            rows_r, slots_r, bals_r, senders_r, acked_r):
-        """Fused coordinator wave (ONE device call;
-        kernels.request_reply_p): new proposals + accept replies of the
-        same worker batch.  Returns what :meth:`propose_self` and
-        :meth:`accept_reply_commit_self` return, as a pair.  Shared
-        bucket bounds the composed kernel's jit cache to the ladder."""
-        np_, nr = len(rows_p), len(rows_r)
-        b = _bucket(max(np_, nr))
-        lo_p, hi_p = _split64(reqs_p)
-        self.state, po, ro = self._k.request_reply_p(
-            self.state,
-            self._packed(np_, (rows_p, 0), (lo_p, 0), (hi_p, 0),
-                         (self_midx, 0), bucket=b),
-            self._packed(nr, (rows_r, 0), (slots_r, NO_SLOT),
-                         (bals_r, NO_BALLOT), (senders_r, 0),
-                         (np.asarray(acked_r, np.int32), 0), bucket=b))
-        p = np.asarray(po)[:, :np_]
-        r = np.asarray(ro)[:, :nr]
-        granted = p[0] != 0
-        pres = (ProposeRes(granted, p[1] != 0, p[2] != 0,
-                           np.where(granted, p[3], NO_SLOT), p[4]),
-                p[5] != 0, p[6] != 0, p[7] != 0, p[8])
-        newly = r[0] != 0
-        rres = (AcceptReplyRes(
-            newly, r[1] != 0, np.where(newly, r[3], 0),
-            np.where(newly, r[4], 0),
-            np.where(newly, r[2], NO_BALLOT)), r[6] != 0, r[7] != 0)
-        return pres, rres
+        return self.propose_self_reply_submit(
+            rows_p, reqs_p, self_midx, rows_r, slots_r, bals_r,
+            senders_r, acked_r).collect()
 
     def prepare(self, rows, bals) -> PrepareRes:
+        rows, bals = np.asarray(rows), np.asarray(bals)
         n = len(rows)
-        self.state, o = self._k.prepare(
-            self.state, self._pad1(rows, 0), self._pad1(bals, NO_BALLOT),
-            self._valid(n))
-        acked, cur_bal, cursor, ws, wb, wl, wh = self._np(o, n)
+        parts = []
+        for a, b in _chunks(n):
+            with self._disp():
+                self.state, o = self._k.prepare(
+                    self.state, self._pad1(rows[a:b], 0),
+                    self._pad1(bals[a:b], NO_BALLOT), self._valid(b - a))
+            # materialize OUTSIDE the dispatch lock (the lock's job is
+            # serializing sharded program dispatch, not d2h transfers)
+            parts.append(self._np(o, b - a))
+        acked, cur_bal, cursor, ws, wb, wl, wh = parts[0] \
+            if len(parts) == 1 else \
+            tuple(np.concatenate(f) for f in zip(*parts))
         # canonicalize the raw slot%W column layout into the SPI contract:
         # live pvalues (slot >= exec_cursor) compacted left, sorted by slot
         live = (ws >= 0) & (ws >= cursor[:, None])
@@ -791,33 +999,46 @@ class ColumnarBackend(AcceptorBackend):
 
     def install_coordinator(self, rows, cbals, next_slots, carry_slot,
                             carry_req) -> None:
-        n = len(rows)
-        b = _bucket(n)
+        rows, cbals = np.asarray(rows), np.asarray(cbals)
+        next_slots = np.asarray(next_slots)
         W = self._window
-        cs = np.full((b, W), NO_SLOT, np.int32)
-        cl = np.zeros((b, W), np.int32)
-        ch = np.zeros((b, W), np.int32)
         m = carry_slot.shape[1]
-        cs[:n, :m] = carry_slot
         lo, hi = _split64(carry_req.reshape(-1))
-        cl[:n, :m] = lo.reshape(n, m)
-        ch[:n, :m] = hi.reshape(n, m)
-        self.state, _ = self._k.install_coordinator(
-            self.state, self._pad1(rows, 0), self._pad1(cbals, NO_BALLOT),
-            self._pad1(next_slots, 0), self._dev(cs), self._dev(cl),
-            self._dev(ch), self._valid(n))
+        lo = lo.reshape(len(rows), m)
+        hi = hi.reshape(len(rows), m)
+        for a, bnd in _chunks(len(rows)):
+            n = bnd - a
+            b = _bucket(n)
+            cs = np.full((b, W), NO_SLOT, np.int32)
+            cl = np.zeros((b, W), np.int32)
+            ch = np.zeros((b, W), np.int32)
+            cs[:n, :m] = carry_slot[a:bnd]
+            cl[:n, :m] = lo[a:bnd]
+            ch[:n, :m] = hi[a:bnd]
+            with self._disp():
+                self.state, _ = self._k.install_coordinator(
+                    self.state, self._pad1(rows[a:bnd], 0),
+                    self._pad1(cbals[a:bnd], NO_BALLOT),
+                    self._pad1(next_slots[a:bnd], 0), self._dev(cs),
+                    self._dev(cl), self._dev(ch), self._valid(n))
 
     def set_cursor(self, rows, cursors, next_slots) -> None:
-        n = len(rows)
-        self.state, _ = self._k.set_cursor(
-            self.state, self._pad1(rows, 0), self._pad1(cursors, 0),
-            self._pad1(next_slots, 0), self._valid(n))
+        rows, cursors = np.asarray(rows), np.asarray(cursors)
+        next_slots = np.asarray(next_slots)
+        for a, b in _chunks(len(rows)):
+            with self._disp():
+                self.state, _ = self._k.set_cursor(
+                    self.state, self._pad1(rows[a:b], 0),
+                    self._pad1(cursors[a:b], 0),
+                    self._pad1(next_slots[a:b], 0), self._valid(b - a))
 
     def gc(self, rows, upto) -> None:
-        n = len(rows)
-        self.state, _ = self._k.gc(
-            self.state, self._pad1(rows, 0), self._pad1(upto, NO_SLOT),
-            self._valid(n))
+        rows, upto = np.asarray(rows), np.asarray(upto)
+        for a, b in _chunks(len(rows)):
+            with self._disp():
+                self.state, _ = self._k.gc(
+                    self.state, self._pad1(rows[a:b], 0),
+                    self._pad1(upto[a:b], NO_SLOT), self._valid(b - a))
 
     def cursor_of(self, row: int) -> int:
         return int(self.state.exec_cursor[row])
@@ -829,8 +1050,9 @@ class ColumnarBackend(AcceptorBackend):
         """ONE gather + ONE device->host transfer for the whole sweep."""
         from gigapaxos_tpu.ops.kernels import gather_rows
         import jax
-        r = gather_rows(self.state, np.asarray(rows, np.int32))
-        host = jax.device_get(r)
+        with self._disp():
+            r = gather_rows(self.state, np.asarray(rows, np.int32))
+            host = jax.device_get(r)
         return [{f: np.asarray(v[i]) for f, v in zip(host._fields, host)}
                 for i in range(len(rows))]
 
@@ -844,6 +1066,7 @@ class ColumnarBackend(AcceptorBackend):
                 np.asarray(snap[f]).astype(
                     getattr(self.state, f).dtype)[None])
                for f in ColumnarState._fields})
-        self.state, _ = scatter_rows(
-            self.state, self._dev(np.asarray([row], np.int32)), row_state,
-            self._dev(np.asarray([True])))
+        with self._disp():
+            self.state, _ = scatter_rows(
+                self.state, self._dev(np.asarray([row], np.int32)),
+                row_state, self._dev(np.asarray([True])))

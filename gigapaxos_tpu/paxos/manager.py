@@ -444,6 +444,10 @@ class PaxosNode:
         self._tick_hooks: List = []
 
         self._inq: "queue_mod.Queue" = queue_mod.Queue()
+        # 3-stage pipeline hand-off (set by _worker_loop_pipelined):
+        # when not None, _process hands (responses, outbound frames) to
+        # the emit thread instead of flushing inline
+        self._emit_q: Optional["queue_mod.Queue"] = None
         # batched client-response buffer, live only inside _process
         self._resp_out: Optional[Dict] = None
         # batched outbound sends, live only inside _process: flushed as
@@ -983,21 +987,31 @@ class PaxosNode:
                 self.transport.send_threadsafe(dst, buf)
         # else: recovery runs before sockets exist; peers re-sync later
 
-    def _flush_responses(self) -> None:
-        out, self._resp_out = self._resp_out, None
-        if not out:
-            return
-        for dst, items in out.items():
-            buf = native.encode_responses(
-                self.id,
-                np.asarray([it[0] for it in items], np.uint64),
-                np.asarray([it[1] for it in items], np.uint64),
-                np.asarray([it[2] for it in items], np.uint8),
-                [it[3] for it in items])
-            if self._out_buf is not None:
-                self._out_buf.append((dst, buf, True, len(items)))
-            else:
-                self.transport.send_raw_threadsafe(dst, buf, len(items))
+    def _emit_bundle(self, resp: Optional[Dict],
+                     out: Optional[List]) -> None:
+        """Encode batched client responses and hand the whole batch's
+        outbound frames to the event loop in ONE hop.  Runs inline at
+        the end of ``_process`` in the 1- and 2-stage workers, and on
+        the dedicated EMIT thread in the 3-stage pipeline — it touches
+        only the transport (never consensus state), so moving it off
+        the process thread is single-writer-safe, and the FIFO hand-off
+        queue preserves per-destination send order."""
+        if resp:
+            out = out if out is not None else []
+            for dst, items in resp.items():
+                buf = native.encode_responses(
+                    self.id,
+                    np.asarray([it[0] for it in items], np.uint64),
+                    np.asarray([it[1] for it in items], np.uint64),
+                    np.asarray([it[2] for it in items], np.uint8),
+                    [it[3] for it in items])
+                out.append((dst, buf, True, len(items)))
+        if out and self._loop is not None:
+            try:
+                self.transport.send_many_threadsafe(out)
+            except RuntimeError:
+                if not self._stopping:  # closed loop mid-crash-stop
+                    raise
 
     # ------------------------------------------------------------------
     # worker
@@ -1064,15 +1078,47 @@ class PaxosNode:
                 self._tick()
 
     def _worker_loop_pipelined(self) -> None:
-        """Two-stage worker (PC.PIPELINE_WORKER; SURVEY §7.1 "build
-        batch N+1 on host while the kernel runs batch N"): this thread
-        collects + decodes; a process thread runs engine + WAL + sends.
-        The hand-off queue is depth-2 — one batch in flight, one being
-        built — so memory stays bounded and backpressure reaches the
-        socket the same way the single-stage loop's service rate does.
-        All engine/mirror state stays single-writer (the process thread
-        + the engine lock); decode is stateless."""
+        """Three-stage worker (PC.PIPELINE_WORKER; SURVEY §7.1 "build
+        batch N+1 on host while the kernel runs batch N"):
+
+            intake  — this thread: collect + decode batch N+1
+            process — engine dispatch + host apply (mirrors, WAL fsync,
+                      execute) for batch N; the engine's async
+                      submit/collect split lets the device wave run
+                      while the host halves of the same batch proceed
+            emit    — response encode + socket hand-off for batch N-1
+
+        So wave N's device compute+transfer overlaps wave N-1's emit
+        and wave N+1's decode/pack.  Both hand-off queues are depth-2 —
+        one batch in flight, one staged — so memory stays bounded and
+        backpressure reaches the socket the same way the single-stage
+        loop's service rate does.  Per-group in-order execution is
+        preserved: ALL consensus state (engine, mirrors, WAL, app)
+        stays single-writer on the process thread in batch order, and
+        the emit stage only ships already-encoded transport frames in
+        FIFO order.  The WAL-before-reply durability barrier is
+        unchanged too: handlers fsync inside _process, strictly before
+        the batch's frames are handed to emit."""
         stage: "queue_mod.Queue" = queue_mod.Queue(maxsize=2)
+        emitq: "queue_mod.Queue" = queue_mod.Queue(maxsize=2)
+
+        def emit_loop() -> None:
+            while True:
+                item = emitq.get()
+                if item is None:
+                    return
+                t0 = time.monotonic()
+                resp, out = item
+                # count BEFORE _emit_bundle: it appends the encoded
+                # response frames to `out`, which would double-count
+                n_items = (len(out) if out else 0) + \
+                    (sum(len(v) for v in resp.values()) if resp else 0)
+                try:
+                    self._emit_bundle(resp, out)
+                except Exception:
+                    if not self._stopping:
+                        log.exception("emit stage failed")
+                DelayProfiler.update_total("w.emit", t0, n_items)
 
         def proc_loop() -> None:
             while True:
@@ -1098,9 +1144,13 @@ class PaxosNode:
                 with self._engine_lock:
                     self._tick()
 
+        emit = threading.Thread(target=emit_loop, daemon=True,
+                                name=f"gp-node{self.id}-emit")
+        emit.start()
         proc = threading.Thread(target=proc_loop, daemon=True,
                                 name=f"gp-node{self.id}-proc")
         proc.start()
+        self._emit_q = emitq
         prev_items = 0
         try:
             while not self._stopping:
@@ -1136,10 +1186,24 @@ class PaxosNode:
                                   len(batch))
                     continue
                 DelayProfiler.update_total("w.decode", t0, len(batch))
+                t0 = time.monotonic()
                 stage.put(decoded)  # blocks at depth 2: backpressure
+                DelayProfiler.update_total("w.decode_blocked", t0)
         finally:
             stage.put(None)
-            proc.join(5)
+            # the process stage can legitimately sit in a 10-20s cold
+            # jit compile mid-batch; the emit sentinel must not be
+            # enqueued while proc is still alive, or proc's remaining
+            # hand-offs land in a consumer-less queue (blocked put +
+            # silently dropped responses).  60s covers the worst
+            # observed compile; past that the daemon threads die with
+            # the process anyway.
+            proc.join(60)
+            # emit drains AFTER the process stage: frames of the last
+            # batch must still ship on a graceful stop
+            emitq.put(None)
+            emit.join(10)
+            self._emit_q = None
 
     def _tick(self) -> None:
         """Periodic duties: failure detection → run-for-coordinator.
@@ -1376,14 +1440,18 @@ class PaxosNode:
                 for obj in self._self_buf:  # cap hit: requeue leftovers
                     self._inq.put(obj)
             self._self_buf = None
-            self._flush_responses()
+            resp, self._resp_out = self._resp_out, None
             out, self._out_buf = self._out_buf, None
-            if out and self._loop is not None:
-                try:
-                    self.transport.send_many_threadsafe(out)
-                except RuntimeError:
-                    if not self._stopping:  # closed loop mid-crash-stop
-                        raise
+            if self._emit_q is not None and (resp or out):
+                # 3-stage pipeline: response encode + socket hand-off
+                # run on the emit thread, overlapping the next batch's
+                # engine wave here.  Blocking at depth 2 is the same
+                # backpressure the inline flush exerted.
+                t0 = time.monotonic()
+                self._emit_q.put((resp, out))
+                DelayProfiler.update_total("w.emit_blocked", t0)
+            else:
+                self._emit_bundle(resp, out)
 
     def _process_inner(self, batch: List) -> None:
         by_type: Dict[type, List] = {}
@@ -1465,10 +1533,29 @@ class PaxosNode:
         replies = by_type.pop(pkt.AcceptReplyBatch, [])
         # fused coordinator wave (columnar): requests + replies in one
         # device dispatch.  Reply-side state (votes/cbal) and accept-
-        # side state (bal/acc_*) are disjoint on device, and a node
-        # only receives accepts for groups it does NOT coordinate and
-        # replies for groups it does, so hoisting replies past accepts
-        # cannot reorder same-group work.
+        # side state (bal/acc) are disjoint on device, and in steady
+        # state a node only receives accepts for groups it does NOT
+        # coordinate and replies for groups it does, so hoisting
+        # replies past accepts cannot reorder same-group work.
+        # Coordinator HANDOFF is the exception worth spelling out: for
+        # a beat after an election, a node can see BOTH accepts and
+        # replies for the SAME group in one batch — the dying
+        # coordinator's in-flight accepts arrive alongside replies to
+        # the accepts we re-drove at our new ballot.  The hoist is
+        # still safe then: (a) the reply kernel counts votes only at
+        # bal == cbal, and stale-regime replies carry the OLD ballot,
+        # so they are ignored regardless of order; (b) the accept
+        # kernel's only write shared with the reply path is the
+        # promised-ballot max, which is monotone — applying the old
+        # coordinator's accept before or after our reply wave yields
+        # the same max and the same ack/nack decision for every lane
+        # (a lower-ballot accept nacks either way once our install
+        # raised the promise); (c) the self-accept inside the fused
+        # request kernel writes our OWN row's acc window, which the
+        # foreign accept cannot touch in the same batch — the manager's
+        # (row, slot) coalesce keeps one lane per slot and a foreign
+        # coordinator of the same row would be a second regime whose
+        # lower ballot loses the max either way.
         fuse_coord = bool(replies) and (reqs or props or soas) \
             and self._fuse_waves
         if fuse_coord:
@@ -1488,16 +1575,30 @@ class PaxosNode:
                 len(reqs) + len(props) + sum(len(s.gkey) for s in soas),
                 cpu_t0=c0)
         fuse_wave = accepts and commits and self._fuse_waves
-        if fuse_wave:
-            # fused acceptor wave: both types -> ONE device dispatch.
-            # Safe to hoist commits past replies: the commit kernel
-            # writes dec/exec state only, the reply kernel reads vote/
-            # coordinator state only (they commute), and commits in
-            # this batch are from prior waves.  The C-engine path keeps
-            # the split handlers (its per-stage calls are sub-ms).
+        # async overlapped acceptor wave (columnar, fusion off — the
+        # host-XLA operating point): submit the accept wave AND the
+        # commit wave back-to-back, then run the host halves in split-
+        # handler order, so the commit wave's device time overlaps the
+        # accept half's WAL fsync + reply build.  Same hoist-safety
+        # argument as fuse_wave (commit writes dec/exec only; both
+        # waves' pres touch only commutative mirror maxes).
+        overlap_wave = bool(accepts) and bool(commits) \
+            and not fuse_wave and self._col_self is not None
+        if fuse_wave or overlap_wave:
+            # fused acceptor wave: both types -> ONE device dispatch
+            # (or one submit+submit overlap).  Safe to hoist commits
+            # past replies: the commit kernel writes dec/exec state
+            # only, the reply kernel reads vote/coordinator state only
+            # (they commute), and commits in this batch are from prior
+            # waves.  The C-engine path keeps the split handlers (its
+            # per-stage calls are sub-ms).
             t0 = time.monotonic()
             c0 = self._ct()
-            self._handle_accepts_commits(accepts, commits)
+            if fuse_wave:
+                self._handle_accepts_commits(accepts, commits)
+            else:
+                self._handle_accepts_commits_overlapped(accepts,
+                                                        commits)
             DelayProfiler.update_total(
                 "w.acc_com", t0, len(accepts) + len(commits),
                 cpu_t0=c0)
@@ -1513,7 +1614,7 @@ class PaxosNode:
             self._handle_accept_replies(replies)
             DelayProfiler.update_total("w.replies", t0, len(replies),
                                        cpu_t0=c0)
-        if commits and not fuse_wave:
+        if commits and not fuse_wave and not overlap_wave:
             t0 = time.monotonic()
             c0 = self._ct()
             self._handle_commits(commits)
@@ -1548,6 +1649,18 @@ class PaxosNode:
     def stats(self) -> str:
         """One-line node counters (ref: the reference's periodic
         DelayProfiler/NIOInstrumenter stats lines)."""
+        t = DelayProfiler.totals()
+
+        def s(tag):
+            return t.get(tag, (0.0,))[0]
+
+        # engine overlap split (process-global, like the reference's
+        # DelayProfiler): sub = host wall launching waves, blk = wall
+        # blocked materializing device results, ovl = submit->collect
+        # gap the host spent on other work while the device ran
+        eng = (f"eng[sub={s('eng.submit'):.2f}s "
+               f"blk={s('eng.collect'):.2f}s "
+               f"ovl={s('eng.overlap'):.2f}s]")
         return (f"exec={self.n_executed} dec={self.n_decided} "
                 f"paused={self.n_paused}/{self.n_unpaused} "
                 f"redrive={self.n_redriven}"
@@ -1556,6 +1669,7 @@ class PaxosNode:
                 f"shed={self.n_shed} "
                 f"installs={self.n_installs} "
                 f"groups={len(self.table)} "
+                f"{eng} "
                 f"net[{self.transport.stats()}]")
 
     # -- request/proposal → propose ------------------------------------
@@ -2142,14 +2256,11 @@ class PaxosNode:
         for dst, arb in out:
             self._route(dst, arb)
 
-    def _handle_accepts_commits(self, accepts: List,
-                                commits: List) -> None:
-        """Fused acceptor wave: the accepts and commits of one worker
-        batch go to the engine in ONE device dispatch
-        (``backend.accept_commit`` → ``kernels.accept_commit_p``),
-        with the host halves unchanged and in the split handlers'
-        order — accept post (payload store + WAL durability barrier +
-        replies) runs before commit post (install + execute)."""
+    def _acc_com_pre(self, accepts: List, commits: List):
+        """Shared lane gather + host pre halves for the two acceptor-
+        wave handlers (fused single-dispatch and async-overlapped), so
+        the coalesce keys and hoist-safety invariants live in ONE
+        place.  Returns (a_gkeys, apre, c_gkeys, cpre)."""
         a_gkeys = _cat(accepts, lambda o: np.asarray(o.gkey, np.uint64))
         a_slots = _cat(accepts, lambda o: np.asarray(o.slot, np.int32))
         a_bals = _cat(accepts, lambda o: np.asarray(o.bal, np.int32))
@@ -2164,6 +2275,18 @@ class PaxosNode:
         c_reqs = _cat(commits, lambda o: _merge_req(o.req_lo, o.req_hi))
         cpre = self._commit_pre(self._rows_for_keys(c_gkeys), c_slots,
                                 c_bals, c_reqs, time.time())
+        return a_gkeys, apre, c_gkeys, cpre
+
+    def _handle_accepts_commits(self, accepts: List,
+                                commits: List) -> None:
+        """Fused acceptor wave: the accepts and commits of one worker
+        batch go to the engine in ONE device dispatch
+        (``backend.accept_commit`` → ``kernels.accept_commit_p``),
+        with the host halves unchanged and in the split handlers'
+        order — accept post (payload store + WAL durability barrier +
+        replies) runs before commit post (install + execute)."""
+        a_gkeys, apre, c_gkeys, cpre = self._acc_com_pre(accepts,
+                                                         commits)
         if apre is not None and cpre is not None:
             idxs, rows, slots, bals, req_ids, senders, now = apre
             sel, rows_s, slots_s, reqs_s = cpre
@@ -2183,6 +2306,37 @@ class PaxosNode:
             res = self.backend.commit(rows_s, slots_s, reqs_s)
             self._commit_post(c_gkeys, sel, rows_s, slots_s, reqs_s,
                               res)
+
+    def _handle_accepts_commits_overlapped(self, accepts: List,
+                                           commits: List) -> None:
+        """Async double-buffered acceptor wave (the tentpole overlap):
+        SUBMIT the accept wave, SUBMIT the commit wave — the engine
+        applies them in submission order, exactly the split handlers'
+        order — then collect + run the host halves.  While the commit
+        wave computes (and its outputs copy back), the accept half's
+        host apply runs: payload store, WAL fsync durability barrier,
+        reply build.  Hoisting the commit SUBMIT above the accept POST
+        is safe because ``_commit_pre`` touches only the ``_bal``
+        monotone-max mirror and ``_la`` stamps — commutative with
+        ``_acc_post``'s own ``np.maximum.at`` writes — and the device
+        ordering is fixed at submission."""
+        a_gkeys, apre, c_gkeys, cpre = self._acc_com_pre(accepts,
+                                                         commits)
+        awave = cwave = None
+        if apre is not None:
+            idxs, rows, slots, bals, req_ids, senders, now = apre
+            awave = self.backend.accept_submit(rows, slots, bals,
+                                               req_ids)
+        if cpre is not None:
+            sel, rows_s, slots_s, reqs_s = cpre
+            cwave = self.backend.commit_submit(rows_s, slots_s, reqs_s)
+        if awave is not None:
+            # accept host apply overlaps the commit wave's device time
+            self._acc_post(accepts, a_gkeys, idxs, rows, slots, bals,
+                           req_ids, senders, now, awave.collect())
+        if cwave is not None:
+            self._commit_post(c_gkeys, sel, rows_s, slots_s, reqs_s,
+                              cwave.collect())
 
     def _handle_requests_replies(self, reqs: List, props: List,
                                  soas: Tuple, replies: List) -> None:

@@ -39,7 +39,7 @@ def _totals_delta(before: dict, after: dict) -> dict:
     out = {}
     for tag, t in after.items():
         if not (tag.startswith("w.") or tag.startswith("node.")
-                or tag.startswith("fo.")):
+                or tag.startswith("fo.") or tag.startswith("eng.")):
             continue
         b = before.get(tag, (0.0, 0, 0, 0.0))
         d = (t[0] - b[0], t[1] - b[1], t[2] - b[2], t[3] - b[3])
@@ -105,15 +105,22 @@ def mode_throughput(args) -> dict:
             # median-of-N against this box's 2-3x window swings (the
             # storm bench's policy, applied to the e2e rows): re-run
             # the measured load and report the median run's numbers
-            # with every trial's rate in the row
+            # with every trial's rate in the row.  Stage totals are
+            # recorded PER TRIAL so the median row carries its OWN
+            # budget split — attaching trial 1's totals to whatever
+            # trial the sort picked misattributed the stage budget
+            # whenever the trials swung (ADVICE round 5).
             runs = [stats]
             for t in range(args.trials - 1):
-                runs.append(emu.run_load_fast(
+                before_t = DelayProfiler.totals()
+                r = emu.run_load_fast(
                     args.requests, concurrency=depth,
-                    client_id=(1 << 24) + t))
+                    client_id=(1 << 24) + t)
+                r["stage_totals"] = _totals_delta(
+                    before_t, DelayProfiler.totals())
+                runs.append(r)
             runs.sort(key=lambda r: r["throughput_rps"])
             med = runs[len(runs) // 2]
-            med["stage_totals"] = stats.get("stage_totals")
             med["trial_rps"] = [round(r["throughput_rps"], 1)
                                 for r in runs]
             lo, hi = med["trial_rps"][0], med["trial_rps"][-1]

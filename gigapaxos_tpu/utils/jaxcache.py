@@ -22,11 +22,30 @@ _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 CACHE_DIR = os.path.join(_REPO_ROOT, ".jax_cache")
 
+# once-flag: the cache knobs are PROCESS-GLOBAL jax config.  Every
+# ColumnarBackend construction calls this, and before the guard each
+# one silently re-pointed the global cache dir — clobbering an earlier
+# explicit `dirpath` (or an operator's own jax_compilation_cache_dir)
+# from a completely unrelated backend init.  First caller wins; later
+# calls are no-ops reporting whether a cache is active — holding the
+# ACTIVE dir so a later request for a different one can be refused.
+_enabled: str | None = None
+
 
 def enable_persistent_cache(dirpath: str | None = None) -> bool:
-    """Point jax at the repo-local compilation cache.  Best-effort: a
+    """Point jax at the repo-local compilation cache (idempotent; only
+    the first call in a process touches jax config).  Best-effort: a
     jax build without the knobs (or an unwritable dir) degrades to
     normal in-memory caching."""
+    global _enabled
+    if _enabled:
+        if dirpath is not None and dirpath != _enabled:
+            # explicit request for a DIFFERENT dir after the cache is
+            # already active: honoring it would clobber the first
+            # caller's global config — report failure instead of a
+            # silent no-op "success"
+            return False
+        return True
     import jax
     try:
         jax.config.update("jax_compilation_cache_dir",
@@ -36,6 +55,7 @@ def enable_persistent_cache(dirpath: str | None = None) -> bool:
         jax.config.update("jax_persistent_cache_min_compile_time_secs",
                           0.0)
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        _enabled = dirpath or CACHE_DIR
         return True
     except Exception:
         return False

@@ -77,6 +77,22 @@ class DelayProfiler:
             t[3] += dcpu
 
     @classmethod
+    def add_total(cls, tag: str, seconds: float, n: int = 1,
+                  cpu_seconds: float = 0.0) -> None:
+        """Accumulate an already-measured span under ``tag`` (the
+        overlap counters — device-busy vs host-busy vs blocked — are
+        computed from timestamps captured elsewhere, so there is no
+        live ``t0`` to hand update_total)."""
+        if not cls.enabled:
+            return
+        with cls._lock:
+            t = cls._totals.setdefault(tag, [0.0, 0, 0, 0.0])
+            t[0] += seconds
+            t[1] += 1
+            t[2] += n
+            t[3] += cpu_seconds
+
+    @classmethod
     def totals(cls) -> Dict[str, tuple]:
         with cls._lock:
             return {k: tuple(v) for k, v in cls._totals.items()}

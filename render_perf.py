@@ -45,6 +45,17 @@ def _fmt_k(v):
     return f"{v:,.0f}" if v >= 100 else f"{v:g}"
 
 
+def _fmt_ms(v, why=""):
+    """Latency cell: a null value must never render as the literal
+    string 'None ms'.  ``why`` names the reason where one is KNOWN —
+    bench.py deliberately voids the storm-step percentiles on the
+    host-XLA fallback (a 256K-lane step on one CPU core measures
+    nothing a user would see); other rows just say n/a."""
+    if v is not None:
+        return f"{v} ms"
+    return f"n/a ({why})" if why else "n/a"
+
+
 def render() -> str:
     full = _load("BENCH_FULL.json") or {}
     tpu = _load("BENCH_TPU_LAST_GOOD.json")
@@ -73,7 +84,8 @@ def render() -> str:
             f"({_fmt_k(i.get('native_baseline_dps'))}/s; the baseline "
             "itself swings 2-3× across windows on this shared box — "
             "see BASELINE.md); step p99 "
-            f"{tpu.get('p99_ms')} ms at 256K lanes/step; recorded "
+            f"{_fmt_ms(tpu.get('p99_ms'), 'host-XLA fallback')} at "
+            "256K lanes/step; recorded "
             f"{tpu.get('recorded_at')} |")
     else:
         out.append("| Decisions/sec on the REAL TPU | no healthy-"
@@ -94,8 +106,8 @@ def render() -> str:
             f"engine — platform {i.get('platform')}"
             + (" (labeled host-XLA fallback)"
                if "FALLBACK" in r.get("metric", "") else "")
-            + f"; e2e latency point p50 {r.get('e2e_req_p50_ms')} ms / "
-              f"p99 {r.get('e2e_req_p99_ms')} ms |")
+            + f"; e2e latency point p50 {_fmt_ms(r.get('e2e_req_p50_ms'))}"
+              f" / p99 {_fmt_ms(r.get('e2e_req_p99_ms'))} |")
 
     r = row("config1_e2e_3r_1k_groups")
     if r:
@@ -105,7 +117,8 @@ def render() -> str:
             "sockets (config 1, native engine) | "
             f"**{_fmt_k(r['value'])} req/s** at depth 2048; latency "
             f"point: {_fmt_k(lp.get('throughput_rps'))} req/s, p50 "
-            f"{lp.get('lat_p50_ms')} ms / p99 {lp.get('lat_p99_ms')} ms "
+            f"{_fmt_ms(lp.get('lat_p50_ms'))} / p99 "
+            f"{_fmt_ms(lp.get('lat_p99_ms'))} "
             "at depth 32 — one core shared by 3 nodes + client |")
 
     r = row("config2_columnar_100k_groups_host_xla_knee")
@@ -115,8 +128,8 @@ def render() -> str:
             "| Columnar served path, 100K groups (config 2, host XLA, "
             "pipelined) | "
             f"**{_fmt_k(r['value'])} req/s at the swept knee** (depth "
-            f"{i.get('knee_depth')}, p99 {i.get('lat_p99_ms')} ms ≤ "
-            f"{i.get('p99_bound_ms', 500)} ms bound); the artifact "
+            f"{i.get('knee_depth')}, p99 {_fmt_ms(i.get('lat_p99_ms'))} "
+            f"≤ {i.get('p99_bound_ms', 500)} ms bound); the artifact "
             "records the operating point, not the deepest closed loop "
             "(round-4 row was a congestion collapse: 227 req/s, p99 "
             "8.8 s); stage budget in `info.stage_totals` |")
@@ -209,8 +222,8 @@ def render() -> str:
                 f"| ONE hot group, closed loop, 3 replicas (config 6, "
                 f"{eng}) | **{_fmt_k(r['value'])} req/s** at the knee "
                 f"depth {i.get('knee_depth')} = W (the slot window is "
-                f"the pipeline bound; p99 {i.get('lat_p99_ms')} ms; "
-                "depth 2W cliffs into retransmit amplification — see "
+                f"the pipeline bound; p99 {_fmt_ms(i.get('lat_p99_ms'))}"
+                "; depth 2W cliffs into retransmit amplification — see "
                 "`info.depth_sweep`) |")
 
     r = row("config6b_hot_group_native_w64")
@@ -219,8 +232,8 @@ def render() -> str:
         out.append(
             "| Same hot group, 64-slot window (config 6b, native) | "
             f"**{_fmt_k(r['value'])} req/s** at knee depth "
-            f"{i.get('knee_depth')} (p99 {i.get('lat_p99_ms')} ms) — "
-            "the window knob, not the engine, sets the single-group "
+            f"{i.get('knee_depth')} (p99 {_fmt_ms(i.get('lat_p99_ms'))})"
+            " — the window knob, not the engine, sets the single-group "
             "ceiling |")
 
     out.append("")

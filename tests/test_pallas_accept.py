@@ -6,7 +6,7 @@ import pytest
 
 from gigapaxos_tpu.ops import kernels
 from gigapaxos_tpu.ops.pallas_accept import PallasAccept, group_lanes_by_block
-from gigapaxos_tpu.ops.types import NO_BALLOT, make_state
+from gigapaxos_tpu.ops.types import ACC_RHI, ACC_RLO, ACC_SLOT, make_state
 
 
 def _mk_state(G=64, W=8, n_active=56):
@@ -66,8 +66,7 @@ def test_pallas_accept_matches_xla(seed):
         np.testing.assert_array_equal(np.asarray(o.out_window), out_win)
         np.testing.assert_array_equal(
             np.asarray(o.cur_bal)[valid], cur_bal[valid])
-        for field in ("bal", "acc_bal", "acc_slot", "acc_req_lo",
-                      "acc_req_hi"):
+        for field in ("bal", "acc"):
             np.testing.assert_array_equal(
                 np.asarray(getattr(st_ref, field)),
                 np.asarray(getattr(st_pal, field)),
@@ -85,7 +84,7 @@ def test_pallas_accept_untouched_rows_preserved():
     one = lambda x: jnp.asarray(np.asarray([x], np.int32))  # noqa: E731
     st, _ = kernels.accept(st, one(3), one(0), one(0), one(7), one(9),
                            jnp.asarray([True]))
-    before = np.asarray(st.acc_req_lo[3]).copy()
+    before = np.asarray(st.acc[3, :, ACC_RLO]).copy()
 
     pal = PallasAccept(L=4, interpret=True)
     g = np.asarray([10, 11], np.int32)
@@ -94,8 +93,9 @@ def test_pallas_accept_untouched_rows_preserved():
         np.full(2, 5, np.int32), np.full(2, 6, np.int32),
         np.ones(2, bool))
     assert acked.all()
-    np.testing.assert_array_equal(np.asarray(st.acc_req_lo[3]), before)
-    assert int(st.acc_req_lo[10, 0]) == 5
+    np.testing.assert_array_equal(np.asarray(st.acc[3, :, ACC_RLO]),
+                                  before)
+    assert int(st.acc[10, 0, ACC_RLO]) == 5
 
 
 def test_pallas_accept_multi_lane_rows_and_overflow():
@@ -118,9 +118,9 @@ def test_pallas_accept_multi_lane_rows_and_overflow():
     assert acked.all() and not stale.any() and not ow.any()
     for i in range(6):
         r, s = int(g[i]), int(slot[i])
-        assert int(st.acc_slot[r, s % W]) == s
-        assert int(st.acc_req_lo[r, s % W]) == 10 + i
-        assert int(st.acc_req_hi[r, s % W]) == 20 + i
+        assert int(st.acc[r, s % W, ACC_SLOT]) == s
+        assert int(st.acc[r, s % W, ACC_RLO]) == 10 + i
+        assert int(st.acc[r, s % W, ACC_RHI]) == 20 + i
 
 
 def test_columnar_backend_pallas_path():

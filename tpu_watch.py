@@ -17,7 +17,13 @@ outage — and the recovery — a tracked artifact:
   its ``recorded_at`` is older than ``--stale-hours``, immediately run
   ``bench.py`` (which atomically records that file on any
   real-accelerator run; its internal bench_lock serializes against
-  manual bench runs).
+  manual bench runs);
+- on the FIRST healthy probe of a window (the previous probe was not
+  ok, or the watcher just started), run the on-device e2e capture
+  unconditionally — it used to hide behind the storm artifact's 3h
+  staleness gate, which meant a tunnel that healed within 3h of a
+  storm capture never produced ``BENCH_ONDEVICE_LAST_GOOD.json`` at
+  all (the round-5 headline miss).
 
 Run it for a whole session::
 
@@ -156,15 +162,27 @@ def main() -> int:
                    help="one probe (+capture if due), then exit")
     args = p.parse_args()
     sys.path.insert(0, HERE)
+    prev_ok = False
     while True:
         # per-iteration guard: an always-on watcher that dies on one
         # transient error (ENOSPC, a flaky probe import) is the exact
         # passive-capture failure it exists to fix
         try:
             rec = probe()
-            if rec["outcome"] == "ok" and \
-                    last_good_age_h() > args.stale_hours:
+            healthy = rec["outcome"] == "ok"
+            captured = False
+            if healthy and last_good_age_h() > args.stale_hours:
                 rec.update(capture(args.bench_budget))
+                captured = "ondevice" in rec
+            if healthy and not prev_ok and not captured:
+                # first healthy probe of this window: grab the
+                # on-device e2e row NOW, independent of the storm
+                # artifact's staleness gate — healthy windows are rare
+                # and short on this host's tunnel, and the gated path
+                # above only runs capture_ondevice after a full storm
+                # re-capture
+                rec.update(capture_ondevice())
+            prev_ok = healthy
             append_log(rec)
         except Exception as exc:  # noqa: BLE001 - must stay alive
             sys.stderr.write(f"tpu_watch: tick failed: {exc!r}\n")
