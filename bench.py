@@ -287,6 +287,7 @@ def bench_e2e_runtime(n_requests: int = 6000, groups: int = 1000,
     emu = PaxosEmulation(logdir, n_nodes=3, n_groups=groups,
                          backend="native")
     try:
+        from gigapaxos_tpu.utils.profiler import DelayProfiler
         emu.run_load_fast(1000, concurrency=depth)  # warmup
         deep = emu.run_load_fast(n_requests, concurrency=depth)
         lat = emu.run_load_fast(min(n_requests, 1500), concurrency=32,
@@ -300,6 +301,9 @@ def bench_e2e_runtime(n_requests: int = 6000, groups: int = 1000,
                               "throughput_rps": lat["throughput_rps"],
                               "lat_p50_ms": lat["lat_p50_ms"],
                               "lat_p99_ms": lat["lat_p99_ms"]},
+            # stage budgets + histogram tails (p50/p99 per update_delay
+            # tag) embedded in the artifact of record
+            "profiler": DelayProfiler.snapshot(buckets=False),
         }
     finally:
         emu.stop()

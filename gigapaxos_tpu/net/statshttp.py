@@ -1,0 +1,82 @@
+"""Tiny per-node stats listener: GET /metrics | /stats | /healthz.
+
+Every server process becomes scrapeable without the full HTTP gateway:
+a dependency-free asyncio HTTP/1.0-style responder living on the node's
+existing event loop (enabled by ``PC.STATS_PORT``; 0 binds an ephemeral
+port, exposed via :attr:`port`).  ``/metrics`` is Prometheus text
+exposition over the node's ``metrics()`` dict, ``/stats`` the same dict
+as JSON — the machine-readable replacement for scraping the one-line
+``stats()`` render.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Callable, Optional, Tuple
+
+from gigapaxos_tpu.utils.logutil import get_logger
+from gigapaxos_tpu.utils.prom import metrics_response
+
+log = get_logger("gp.statshttp")
+
+
+class StatsListener:
+    """Serves a ``metrics_fn() -> dict`` over loopback HTTP."""
+
+    def __init__(self, metrics_fn: Callable[[], dict],
+                 listen: Tuple[str, int] = ("127.0.0.1", 0)):
+        self.metrics_fn = metrics_fn
+        self.listen = listen
+        self._server: Optional[asyncio.base_events.Server] = None
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(
+            self._handle, self.listen[0], self.listen[1])
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+
+    @property
+    def port(self) -> int:
+        return self._server.sockets[0].getsockname()[1]
+
+    async def _handle(self, reader: asyncio.StreamReader,
+                      writer: asyncio.StreamWriter) -> None:
+        try:
+            line = await reader.readline()
+            try:
+                method, path, _ = line.decode().split(None, 2)
+            except ValueError:
+                return
+            while True:  # drain headers; bodies are not accepted
+                h = await reader.readline()
+                if h in (b"\r\n", b"\n", b""):
+                    break
+            status, ctype, out = self._route(method, path)
+            writer.write(
+                f"HTTP/1.1 {status}\r\n"
+                f"Content-Type: {ctype}\r\n"
+                f"Content-Length: {len(out)}\r\n"
+                f"Connection: close\r\n\r\n".encode() + out)
+            await writer.drain()
+        except (asyncio.IncompleteReadError, ConnectionError, OSError):
+            pass
+        finally:
+            writer.close()
+
+    def _route(self, method: str, path: str):
+        if method != "GET":
+            return "405 Method Not Allowed", "text/plain", b"GET only\n"
+        if path == "/healthz":
+            return "200 OK", "text/plain", b"ok\n"
+        try:
+            resp = metrics_response(path, self.metrics_fn)
+            if resp is not None:
+                return resp
+        except Exception:
+            log.exception("stats render failed")
+            return ("500 Internal Server Error", "text/plain",
+                    b"render failed\n")
+        return "404 Not Found", "text/plain", b"no such route\n"

@@ -120,12 +120,21 @@ class Transport:
         self.test_drop_rate = 0.0
         self._drop_rng = None
 
-        # NIOInstrumenter analog
+        # NIOInstrumenter analog.  dropped_frames stays the total;
+        # the per-cause split lets the metrics plane tell flaky links
+        # (peer_gone/write_error + reconnects) from backpressure
+        # (congestion) — indistinguishable in one number.
         self.sent_frames = 0
         self.sent_bytes = 0
         self.rcvd_frames = 0
         self.rcvd_bytes = 0
         self.dropped_frames = 0
+        self.drop_congestion = 0   # byte-budget (queue or write buffer)
+        self.drop_peer_gone = 0    # no/closing connection to the dest
+        self.drop_write_error = 0  # mid-write connection failure
+        self.drop_test = 0         # test_drop_rate fault injection
+        self.reconnects = 0        # reconnect attempts after 1st connect
+        self.connect_failures = 0  # connect attempts that failed
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -173,6 +182,20 @@ class Transport:
         response batch."""
         return self._enqueue(dst, buf, preframed=True, nframes=nframes)
 
+    def _drop(self, nframes: int, cause: str) -> None:
+        """Count a drop under its cause (congestion keeps feeding the
+        net.drop rate the saturation tests watch)."""
+        self.dropped_frames += nframes
+        if cause == "congestion":
+            self.drop_congestion += nframes
+            DelayProfiler.update_rate("net.drop")
+        elif cause == "peer_gone":
+            self.drop_peer_gone += nframes
+        elif cause == "write_error":
+            self.drop_write_error += nframes
+        else:
+            self.drop_test += nframes
+
     def _enqueue(self, dst: int, payload: bytes, preframed: bool,
                  nframes: int) -> bool:
         if self.test_drop_rate > 0.0:
@@ -180,7 +203,7 @@ class Transport:
                 import random
                 self._drop_rng = random.Random(self.id * 7919 + 13)
             if self._drop_rng.random() < self.test_drop_rate:
-                self.dropped_frames += nframes
+                self._drop(nframes, "test")
                 return False
         if dst in self.addr_map:
             peer = self._peers.get(dst)
@@ -200,16 +223,14 @@ class Transport:
                 w = peer.writer
                 if w.transport.get_write_buffer_size() + len(payload) > \
                         self.max_queue_bytes:
-                    self.dropped_frames += nframes
-                    DelayProfiler.update_rate("net.drop")
+                    self._drop(nframes, "congestion")
                     return False
                 self._write(w, payload, preframed, nframes)
                 return True
             if peer.bytes_queued + len(payload) > self.max_queue_bytes:
                 # a pre-framed batch drops as a unit (paxos tolerates
                 # loss; clients retransmit) — account every frame in it
-                self.dropped_frames += nframes
-                DelayProfiler.update_rate("net.drop")
+                self._drop(nframes, "congestion")
                 return False
             peer.queue.append((payload, preframed, nframes))
             peer.bytes_queued += len(payload)
@@ -218,14 +239,15 @@ class Transport:
         # reply path over an inbound connection (client or unknown peer)
         w = self._inbound.get(dst)
         if w is None or w.is_closing():
-            self.dropped_frames += 1
+            # a pre-framed response batch drops as nframes, like the
+            # congestion path — else client churn undercounts ~batchx
+            self._drop(nframes, "peer_gone")
             return False
         # backpressure: a stalled client must not grow server memory —
         # consult the transport's write buffer against the same byte budget
         if w.transport.get_write_buffer_size() + len(payload) > \
                 self.max_queue_bytes:
-            self.dropped_frames += nframes
-            DelayProfiler.update_rate("net.drop")
+            self._drop(nframes, "congestion")
             return False
         self._write(w, payload, preframed, nframes)
         return True
@@ -261,13 +283,19 @@ class Transport:
     async def _writer_loop(self, dst: int) -> None:
         peer = self._peers[dst]
         backoff = self.reconnect_base_s
+        attempts = 0
         while not self._closed:
-            # (re)connect
+            # (re)connect; every attempt after the first counts as a
+            # reconnect (link-flap visibility for the metrics plane)
             host, port = self.addr_map[dst]
+            if attempts:
+                self.reconnects += 1
+            attempts += 1
             try:
                 reader, writer = await asyncio.open_connection(
                     host, port, ssl=self.ssl_client)
             except OSError:
+                self.connect_failures += 1
                 await asyncio.sleep(backoff)
                 backoff = min(backoff * 2, 2.0)
                 continue
@@ -412,24 +440,44 @@ class Transport:
                 except (ConnectionError, OSError):
                     # reconnect in flight; this frame is lost — the
                     # higher level (checkpoint catch-up) re-requests
-                    self.dropped_frames += 1
+                    self._drop(1, "write_error")
         else:
             w = self._inbound.get(dst)
             if w is None or w.is_closing():
-                self.dropped_frames += len(frames)
+                self._drop(len(frames), "peer_gone")
                 return
             for f in frames:
                 try:
                     self._write(w, f, False, 1)
                     await w.drain()
                 except (ConnectionError, OSError):
-                    self.dropped_frames += 1
+                    self._drop(1, "write_error")
                     return
 
+    def metrics(self) -> dict:
+        """Structured counters (the machine face; :meth:`stats` is the
+        one-line render over this)."""
+        return {
+            "tx_frames": self.sent_frames,
+            "tx_bytes": self.sent_bytes,
+            "rx_frames": self.rcvd_frames,
+            "rx_bytes": self.rcvd_bytes,
+            "dropped_frames": self.dropped_frames,
+            "drops": {
+                "congestion": self.drop_congestion,
+                "peer_gone": self.drop_peer_gone,
+                "write_error": self.drop_write_error,
+                "test": self.drop_test,
+            },
+            "reconnects": self.reconnects,
+            "connect_failures": self.connect_failures,
+        }
+
     def stats(self) -> str:
-        return (f"tx={self.sent_frames}f/{self.sent_bytes}B "
-                f"rx={self.rcvd_frames}f/{self.rcvd_bytes}B "
-                f"drop={self.dropped_frames}")
+        m = self.metrics()
+        return (f"tx={m['tx_frames']}f/{m['tx_bytes']}B "
+                f"rx={m['rx_frames']}f/{m['rx_bytes']}B "
+                f"drop={m['dropped_frames']} recon={m['reconnects']}")
 
 
 def make_ssl_contexts(certfile: str, keyfile: str, cafile: str,

@@ -30,6 +30,7 @@ import numpy as np
 
 from gigapaxos_tpu.ops.oracle import OracleGroup, PValue, make_oracle_group
 from gigapaxos_tpu.ops.types import NO_BALLOT, NO_SLOT
+from gigapaxos_tpu.utils.instrument import RequestInstrumenter
 from gigapaxos_tpu.utils.profiler import DelayProfiler
 
 
@@ -473,18 +474,28 @@ class EngineWave:
     ``eng.collect`` DelayProfiler total, with the submit->collect gap
     (the overlap the caller actually won) under ``eng.overlap``."""
 
-    __slots__ = ("_finish", "_n", "_submitted")
+    __slots__ = ("_finish", "_n", "_submitted", "_wave")
 
     def __init__(self, finish: Callable, n: int):
         self._finish = finish
         self._n = n
         self._submitted = time.monotonic()
+        # bind the wave id at submit: collect may run after the worker
+        # thread has moved on to a later batch's wave
+        self._wave = RequestInstrumenter.current_wave()
 
     def collect(self):
         t0 = time.monotonic()
-        DelayProfiler.add_total("eng.overlap", t0 - self._submitted,
-                                self._n)
+        overlap = t0 - self._submitted
+        DelayProfiler.add_total("eng.overlap", overlap, self._n)
+        # span duration = host blocked materializing; overlap_s attr =
+        # the device-ran-while-host-worked gap — the device-vs-host
+        # split of the wave, queryable per request
+        sp = RequestInstrumenter.span_begin(
+            "eng.collect", wave=self._wave, lanes=self._n,
+            overlap_s=round(overlap, 6))
         res = self._finish()
+        RequestInstrumenter.span_end(sp)
         DelayProfiler.update_total("eng.collect", t0, self._n)
         return res
 
@@ -718,6 +729,9 @@ class ColumnarBackend(AcceptorBackend):
         safe for paxos exactly like the batch linearization (kernels.py
         determinism note), and what the scalar engines do per item."""
         t0 = time.monotonic()
+        sp = RequestInstrumenter.span_begin("eng.submit", lanes=n,
+                                            bucket=_bucket(min(
+                                                n, _BUCKET_CAP)))
         cols = [(np.asarray(c), f) for c, f in cols]
         outs = []
         for a, bnd in _chunks(n):
@@ -727,6 +741,7 @@ class ColumnarBackend(AcceptorBackend):
                     m, *[(c[a:bnd], f) for c, f in cols]))
             _d2h_start(o)
             outs.append((o, m))
+        RequestInstrumenter.span_end(sp, chunks=len(outs))
         DelayProfiler.update_total("eng.submit", t0, n)
         return outs
 
@@ -834,6 +849,8 @@ class ColumnarBackend(AcceptorBackend):
         with BOTH inputs sharing one bucket per chunk (bounds the
         composed kernel's jit cache to the ladder, not its square)."""
         t0 = time.monotonic()
+        sp = RequestInstrumenter.span_begin("eng.submit",
+                                            lanes=n1 + n2, fused=True)
         cols1 = [(np.asarray(c), f) for c, f in cols1]
         cols2 = [(np.asarray(c), f) for c, f in cols2]
         outs1, outs2 = [], []
@@ -854,6 +871,7 @@ class ColumnarBackend(AcceptorBackend):
             _d2h_start(o2)
             outs1.append((o1, b1 - a1))
             outs2.append((o2, b2 - a2))
+        RequestInstrumenter.span_end(sp, chunks=len(outs1))
         DelayProfiler.update_total("eng.submit", t0, n1 + n2)
         return outs1, outs2
 

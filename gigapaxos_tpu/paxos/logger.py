@@ -37,6 +37,7 @@ from dataclasses import dataclass
 from typing import Dict, Iterable, List, Optional, Tuple
 
 from gigapaxos_tpu.utils.logutil import get_logger
+from gigapaxos_tpu.utils.instrument import RequestInstrumenter
 from gigapaxos_tpu.utils.profiler import DelayProfiler
 
 log = get_logger("gp.logger")
@@ -156,12 +157,17 @@ class PaxosLogger:
             raise RuntimeError("logger closed")
         import time
         t0 = time.monotonic()
+        # hot-path WAL logging runs on the worker's engine stage, so
+        # this span carries that batch's wave id — the "WAL fsync"
+        # slice of a traced request's decomposition
+        sp = RequestInstrumenter.span_begin("wal", entries=n_entries)
         with self._wal_lock:
             self._wal.write(buf)
             self._wal.flush()
             if self.sync if fsync is None else fsync:
                 os.fsync(self._wal.fileno())
             over = self._wal.tell() >= self.compact_threshold
+        RequestInstrumenter.span_end(sp)
         DelayProfiler.update_delay("wal.fsync", t0)
         DelayProfiler.update_rate("wal.entries", n_entries)
         if over and not self._compact_pending:

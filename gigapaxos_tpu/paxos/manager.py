@@ -464,6 +464,8 @@ class PaxosNode:
         self._worker_thread: Optional[threading.Thread] = None
         self._loop = None
         self._started = threading.Event()
+        # per-node stats listener (PC.STATS_PORT; started on the loop)
+        self.stats_http = None
 
         # counters (stats(); VERDICT r2 Weak #9: saturation-induced
         # stalls must be countable, not mystery latency)
@@ -492,10 +494,29 @@ class PaxosNode:
             self._loop = asyncio.new_event_loop()
             asyncio.set_event_loop(self._loop)
             self._loop.run_until_complete(self.transport.start())
+            sport = int(Config.get(PC.STATS_PORT))
+            if sport >= 0:
+                # per-node observability listener: every server process
+                # is scrapeable (GET /metrics Prometheus text, /stats
+                # JSON) without the full HTTP gateway.  Best-effort: a
+                # bind failure (fixed port + two roles in one process)
+                # must never take the consensus loop down with it.
+                from gigapaxos_tpu.net.statshttp import StatsListener
+                try:
+                    self.stats_http = StatsListener(
+                        self.metrics, ("127.0.0.1", sport))
+                    self._loop.run_until_complete(
+                        self.stats_http.start())
+                except OSError as exc:
+                    log.warning("node %d: stats listener on port %d "
+                                "unavailable: %s", self.id, sport, exc)
+                    self.stats_http = None
             self._ping_task = self._loop.create_task(self._ping_loop())
             self._started.set()
             self._loop.run_forever()
             # drain cancellations after stop()
+            if self.stats_http is not None:
+                self._loop.run_until_complete(self.stats_http.stop())
             self._loop.run_until_complete(self.transport.stop())
             self._loop.close()
 
@@ -1055,16 +1076,23 @@ class PaxosNode:
             prev_items = n_frames
             self._backlog_est = int(
                 self._inq.qsize() * n_frames / max(1, len(batch)))
+            RequestInstrumenter.set_wave(RequestInstrumenter.next_wave())
             t0 = time.monotonic()
             c0 = self._ct()
             try:
+                sp = RequestInstrumenter.span_begin(
+                    "decode", node=self.id, frames=n_frames)
                 decoded = self._decode_batch(batch)
+                RequestInstrumenter.span_end(sp)
                 t1 = time.monotonic()
                 c1 = self._ct()
                 DelayProfiler.update_total("w.decode", t0, len(batch),
                                            cpu_t0=c0)
+                sp = RequestInstrumenter.span_begin(
+                    "engine", node=self.id, items=len(decoded))
                 with self._engine_lock:
                     self._process(decoded)
+                RequestInstrumenter.span_end(sp)
                 DelayProfiler.update_total("w.process", t1, len(batch),
                                            cpu_t0=c1)
             except Exception:
@@ -1108,29 +1136,37 @@ class PaxosNode:
                 if item is None:
                     return
                 t0 = time.monotonic()
-                resp, out = item
+                wid, resp, out = item
+                RequestInstrumenter.set_wave(wid)
                 # count BEFORE _emit_bundle: it appends the encoded
                 # response frames to `out`, which would double-count
                 n_items = (len(out) if out else 0) + \
                     (sum(len(v) for v in resp.values()) if resp else 0)
+                sp = RequestInstrumenter.span_begin(
+                    "emit", node=self.id, items=n_items)
                 try:
                     self._emit_bundle(resp, out)
                 except Exception:
                     if not self._stopping:
                         log.exception("emit stage failed")
+                RequestInstrumenter.span_end(sp)
                 DelayProfiler.update_total("w.emit", t0, n_items)
 
         def proc_loop() -> None:
             while True:
                 try:
-                    decoded = stage.get(timeout=self.batch_timeout)
+                    item = stage.get(timeout=self.batch_timeout)
                 except queue_mod.Empty:
                     with self._engine_lock:
                         self._tick()
                     continue
-                if decoded is None:
+                if item is None:
                     return
+                wid, decoded = item
+                RequestInstrumenter.set_wave(wid)
                 t0 = time.monotonic()
+                sp = RequestInstrumenter.span_begin(
+                    "engine", node=self.id, items=len(decoded))
                 try:
                     with self._engine_lock:
                         self._process(decoded)
@@ -1138,6 +1174,7 @@ class PaxosNode:
                     if not self._stopping:
                         log.exception("pipelined batch failed "
                                       "(%d items)", len(decoded))
+                RequestInstrumenter.span_end(sp)
                 DelayProfiler.update_total("w.process", t0, len(decoded))
                 DelayProfiler.update_delay("node.batch", t0,
                                            len(decoded))
@@ -1178,16 +1215,25 @@ class PaxosNode:
                 prev_items = n_frames
                 self._backlog_est = int(
                     self._inq.qsize() * n_frames / max(1, len(batch)))
+                # one wave id per batch, handed down the pipeline with
+                # the batch itself so every stage's spans (and the
+                # trace events recorded while processing it) join up
+                wid = RequestInstrumenter.next_wave()
+                RequestInstrumenter.set_wave(wid)
                 t0 = time.monotonic()
+                sp = RequestInstrumenter.span_begin(
+                    "decode", node=self.id, frames=n_frames)
                 try:
                     decoded = self._decode_batch(batch)
                 except Exception:
                     log.exception("pipelined decode failed (%d items)",
                                   len(batch))
                     continue
+                RequestInstrumenter.span_end(sp)
                 DelayProfiler.update_total("w.decode", t0, len(batch))
                 t0 = time.monotonic()
-                stage.put(decoded)  # blocks at depth 2: backpressure
+                # blocks at depth 2: backpressure
+                stage.put((wid, decoded))
                 DelayProfiler.update_total("w.decode_blocked", t0)
         finally:
             stage.put(None)
@@ -1448,10 +1494,13 @@ class PaxosNode:
                 # engine wave here.  Blocking at depth 2 is the same
                 # backpressure the inline flush exerted.
                 t0 = time.monotonic()
-                self._emit_q.put((resp, out))
+                self._emit_q.put((RequestInstrumenter.current_wave(),
+                                  resp, out))
                 DelayProfiler.update_total("w.emit_blocked", t0)
             else:
+                sp = RequestInstrumenter.span_begin("emit", node=self.id)
                 self._emit_bundle(resp, out)
+                RequestInstrumenter.span_end(sp)
 
     def _process_inner(self, batch: List) -> None:
         by_type: Dict[type, List] = {}
@@ -1646,30 +1695,71 @@ class PaxosNode:
         layers: epoch-FSM retries, demand reporting)."""
         self._tick_hooks.append(fn)
 
-    def stats(self) -> str:
-        """One-line node counters (ref: the reference's periodic
-        DelayProfiler/NIOInstrumenter stats lines)."""
+    def metrics(self, include_profiler: bool = True) -> dict:
+        """Structured node metrics: counters + engine overlap split +
+        transport counters + the process-global profiler snapshot and
+        span aggregates.  The machine-readable face (JSON over /stats,
+        Prometheus over /metrics); :meth:`stats` renders the one-line
+        human view over the same dict.  ``include_profiler=False``
+        skips the profiler snapshot and span aggregation (one pass
+        over every histogram and the span ring under the global locks)
+        — the cheap counters-only view the one-line render needs."""
         t = DelayProfiler.totals()
 
         def s(tag):
             return t.get(tag, (0.0,))[0]
 
-        # engine overlap split (process-global, like the reference's
-        # DelayProfiler): sub = host wall launching waves, blk = wall
-        # blocked materializing device results, ovl = submit->collect
-        # gap the host spent on other work while the device ran
-        eng = (f"eng[sub={s('eng.submit'):.2f}s "
-               f"blk={s('eng.collect'):.2f}s "
-               f"ovl={s('eng.overlap'):.2f}s]")
-        return (f"exec={self.n_executed} dec={self.n_decided} "
-                f"paused={self.n_paused}/{self.n_unpaused} "
-                f"redrive={self.n_redriven}"
-                f"(capped={self.n_redrive_capped}) "
-                f"park={self.n_parked}(drop={self.n_park_dropped}) "
-                f"shed={self.n_shed} "
-                f"installs={self.n_installs} "
-                f"groups={len(self.table)} "
-                f"{eng} "
+        out = {
+            "node": self.id,
+            "counters": {
+                "executed": self.n_executed,
+                "decided": self.n_decided,
+                "paused": self.n_paused,
+                "unpaused": self.n_unpaused,
+                "redriven": self.n_redriven,
+                "redrive_capped": self.n_redrive_capped,
+                "parked": self.n_parked,
+                "park_dropped": self.n_park_dropped,
+                "shed": self.n_shed,
+                "installs": self.n_installs,
+                "groups": len(self.table),
+                "backlog_est": self._backlog_est,
+            },
+            # engine overlap split (process-global, like the
+            # reference's DelayProfiler): sub = host wall launching
+            # waves, blk = wall blocked materializing device results,
+            # ovl = submit->collect gap the host spent on other work
+            # while the device ran
+            "engine": {
+                "submit_s": s("eng.submit"),
+                "collect_s": s("eng.collect"),
+                "overlap_s": s("eng.overlap"),
+            },
+            "net": self.transport.metrics(),
+        }
+        if include_profiler:
+            out["profiler"] = DelayProfiler.snapshot()
+            out["spans"] = RequestInstrumenter.span_stats()
+        return out
+
+    def stats(self) -> str:
+        """One-line node counters (ref: the reference's periodic
+        DelayProfiler/NIOInstrumenter stats lines) — a thin formatter
+        over :meth:`metrics`."""
+        m = self.metrics(include_profiler=False)
+        c = m["counters"]
+        e = m["engine"]
+        return (f"exec={c['executed']} dec={c['decided']} "
+                f"paused={c['paused']}/{c['unpaused']} "
+                f"redrive={c['redriven']}"
+                f"(capped={c['redrive_capped']}) "
+                f"park={c['parked']}(drop={c['park_dropped']}) "
+                f"shed={c['shed']} "
+                f"installs={c['installs']} "
+                f"groups={c['groups']} "
+                f"eng[sub={e['submit_s']:.2f}s "
+                f"blk={e['collect_s']:.2f}s "
+                f"ovl={e['overlap_s']:.2f}s] "
                 f"net[{self.transport.stats()}]")
 
     # -- request/proposal → propose ------------------------------------

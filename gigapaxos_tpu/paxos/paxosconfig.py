@@ -108,3 +108,14 @@ class PC(ConfigKey):
     # RequestInstrumenter at FINE level): records recv/prop/acc/dec/exec
     # events into utils.instrument.RequestInstrumenter's global ring
     TRACE_REQUESTS = False
+    # observability plane (ref: the reference's periodic DelayProfiler/
+    # NIOInstrumenter dumps + gigaPaxos' instrumentation endpoints):
+    # STATS_PORT >= 0 starts the per-node HTTP stats listener on that
+    # loopback port (0 = ephemeral; -1 = off) serving GET /metrics
+    # (Prometheus text) and /stats (JSON snapshot)
+    STATS_PORT = -1
+    # periodic stats-line dump interval in seconds (0 = off); with
+    # STATS_JSON the dumper also appends full metrics snapshots as
+    # JSONL into the node's logdir
+    STATS_DUMP_S = 0.0
+    STATS_JSON = False

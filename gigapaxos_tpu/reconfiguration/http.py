@@ -14,6 +14,9 @@ Routes::
     GET  /actives/NAME                                        -> {"actives"}
     POST /request/NAME  raw body = app payload     -> raw app response
     GET  /healthz                                             -> ok
+    GET  /metrics   Prometheus text exposition (process metrics, or a
+                    node's metrics() via the ``metrics_source`` hook)
+    GET  /stats     the same metrics as one JSON snapshot
 
 Run standalone::
 
@@ -40,11 +43,15 @@ class HttpFrontend:
     """Minimal HTTP/1.1 server bridging to the cluster."""
 
     def __init__(self, config: NodeConfig, listen: Tuple[str, int],
-                 client_id: int = (1 << 21) + 7, timeout: float = 10.0):
+                 client_id: int = (1 << 21) + 7, timeout: float = 10.0,
+                 metrics_source=None):
         self.config = config
         self.listen = listen
         self.cli = ReconfigurableAppClient(client_id, config,
                                            timeout=timeout)
+        # /metrics and /stats source: a co-located node's metrics()
+        # when deployed next to one, else the process-global profiler
+        self.metrics_source = metrics_source
         self._server: Optional[asyncio.base_events.Server] = None
 
     async def start(self) -> None:
@@ -142,6 +149,11 @@ class HttpFrontend:
         try:
             if method == "GET" and path == "/healthz":
                 return "200 OK", "text/plain", b"ok\n"
+            if method == "GET" and path in ("/metrics", "/stats"):
+                from gigapaxos_tpu.utils.prom import (metrics_response,
+                                                      process_metrics)
+                return metrics_response(
+                    path, self.metrics_source or process_metrics)
             if method == "GET" and path.startswith("/actives/"):
                 name = path[len("/actives/"):]
                 try:

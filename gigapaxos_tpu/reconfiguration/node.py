@@ -79,8 +79,10 @@ class ReconfigurableNode:
                  **node_kw):
         self.id = node_id
         self.config = config
+        self.logdir = logdir
         self.active: Optional[ActiveReplica] = None
         self.reconfigurator: Optional[Reconfigurator] = None
+        self._stats_dumper = None
         amap = config.addr_map
         if node_id in config.actives:
             self.active = ActiveReplica(
@@ -103,9 +105,52 @@ class ReconfigurableNode:
             self.active.start()
         if self.reconfigurator:
             self.reconfigurator.start()
+        # periodic stats dump (ref: ReconfigurableNode's periodic
+        # DelayProfiler/NIOInstrumenter log lines): PC.STATS_DUMP_S > 0
+        # logs the one-line render every interval; PC.STATS_JSON also
+        # appends full metrics() snapshots as JSONL under the logdir
+        from gigapaxos_tpu.paxos.paxosconfig import PC
+        from gigapaxos_tpu.utils.config import Config
+        every = float(Config.get(PC.STATS_DUMP_S))
+        if every > 0:
+            import os as _os
+
+            from gigapaxos_tpu.utils.statsdump import StatsDumper
+            jsonl = _os.path.join(self.logdir,
+                                  f"stats{self.id}.jsonl") \
+                if bool(Config.get(PC.STATS_JSON)) else None
+            self._stats_dumper = StatsDumper(
+                lambda: (self.stats(),
+                         self.metrics() if jsonl else None),
+                every, jsonl, name=f"gp-stats-{self.id}")
+            self._stats_dumper.start()
 
     def stop(self) -> None:
+        if self._stats_dumper is not None:
+            self._stats_dumper.stop()
+            self._stats_dumper = None
         if self.active:
             self.active.stop()
         if self.reconfigurator:
             self.reconfigurator.stop()
+
+    def metrics(self) -> dict:
+        """Structured metrics for every role this node holds (each
+        role's dict is its PaxosNode's ``metrics()``)."""
+        out: dict = {"node": self.id, "roles": {}}
+        if self.active:
+            out["roles"]["active"] = self.active.node.metrics()
+        if self.reconfigurator:
+            out["roles"]["reconfigurator"] = \
+                self.reconfigurator.node.metrics()
+        return out
+
+    def stats(self) -> str:
+        """One-line render across roles (thin formatter over
+        :meth:`metrics`)."""
+        parts = []
+        if self.active:
+            parts.append(f"ar[{self.active.node.stats()}]")
+        if self.reconfigurator:
+            parts.append(f"rc[{self.reconfigurator.node.stats()}]")
+        return f"node {self.id}: " + " ".join(parts)

@@ -95,6 +95,17 @@ def main(argv=None) -> int:
                         "active.* entries are used; groups are created "
                         "by clients (CreateGroup) or the GROUPS= "
                         "properties key (members = all actives)")
+    p.add_argument("--stats-port", type=int, default=None,
+                   help="per-node HTTP stats listener port (GET /metrics"
+                        " Prometheus text, /stats JSON snapshot); 0 = "
+                        "ephemeral, omit = off (or STATS_PORT= in the "
+                        "properties file)")
+    p.add_argument("--stats-every", type=float, default=None,
+                   help="log a stats line every N seconds (or "
+                        "STATS_EVERY_S= in the properties file)")
+    p.add_argument("--stats-json", action="store_true",
+                   help="with --stats-every, also append full JSON "
+                        "metrics snapshots to <logdir>/stats<id>.jsonl")
     args = p.parse_args(argv)
 
     extras = read_extras(args.config)
@@ -115,6 +126,24 @@ def main(argv=None) -> int:
 
     app_spec = args.app or extras.get("APPLICATION", "KVApp")
     app_factory = load_app(app_spec)
+
+    # observability knobs: flags beat properties-file keys; the node
+    # reads them from Config at start()
+    from gigapaxos_tpu.paxos.paxosconfig import PC
+    from gigapaxos_tpu.utils.config import Config
+    stats_port = args.stats_port if args.stats_port is not None \
+        else (int(extras["STATS_PORT"]) if "STATS_PORT" in extras
+              else None)
+    if stats_port is not None:
+        Config.set(PC.STATS_PORT, stats_port)
+    stats_every = args.stats_every if args.stats_every is not None \
+        else (float(extras["STATS_EVERY_S"])
+              if "STATS_EVERY_S" in extras else 0.0)
+    stats_json = args.stats_json or \
+        extras.get("STATS_JSON", "").lower() in ("1", "true", "yes")
+    if stats_every > 0:
+        Config.set(PC.STATS_DUMP_S, stats_every)
+        Config.set(PC.STATS_JSON, stats_json)
 
     if args.paxos_only:
         # PaxosServer-style deployment: the engine without the control
@@ -147,6 +176,22 @@ def main(argv=None) -> int:
                  app_spec)
         node.start()
 
+    dumper = None
+    if args.paxos_only and stats_every > 0:
+        # the ReconfigurableNode branch starts its own dumper; a bare
+        # PaxosNode gets one here (same line + JSONL contract)
+        import os as _os
+
+        from gigapaxos_tpu.utils.statsdump import StatsDumper
+        jsonl = _os.path.join(args.logdir,
+                              f"stats{args.id}.jsonl") \
+            if stats_json else None
+        dumper = StatsDumper(
+            lambda: (f"node {args.id}: {node.stats()}",
+                     node.metrics() if jsonl else None),
+            stats_every, jsonl, name=f"gp-stats-{args.id}")
+        dumper.start()
+
     stop = threading.Event()
     for sig in (signal.SIGINT, signal.SIGTERM):
         signal.signal(sig, lambda *_: stop.set())
@@ -154,6 +199,8 @@ def main(argv=None) -> int:
         stop.wait()
     finally:
         log.info("node %d stopping", args.id)
+        if dumper is not None:
+            dumper.stop()
         node.stop()
     return 0
 

@@ -140,6 +140,11 @@ def mode_throughput(args) -> dict:
             "lat_p50_ms": lat["lat_p50_ms"],
             "lat_p99_ms": lat["lat_p99_ms"]}
         stats["pipeline_worker"] = bool(args.pipeline)
+        # end-of-run structured profiler snapshot (histogram
+        # percentiles included, raw buckets omitted for artifact size):
+        # stage budgets AND tails live in the one emitted artifact, so
+        # render_perf.py can print both without a re-run
+        stats["profiler"] = DelayProfiler.snapshot(buckets=False)
         if args.on_device:
             stats["device_dispatch_rtt_ms"] = _dispatch_rtt_ms()
         return {

@@ -1,4 +1,4 @@
-"""Per-request cross-stage tracing.
+"""Per-request cross-stage tracing + pipeline-stage spans.
 
 Reference analog: ``gigapaxos/paxosutil/RequestInstrumenter.java`` — at
 FINE log level the reference records per-request send/receive timestamps
@@ -10,35 +10,72 @@ near-zero cost when disabled (one class-attribute check at each hook).
 Stages recorded by the node runtime: ``recv`` (entry intake), ``prop``
 (slot granted at the coordinator), ``acc`` (accept fsync-durable),
 ``dec`` (quorum crossed), ``exec`` (app executed / response queued).
+
+Spans (the metrics-plane extension): the 3-stage worker (``decode`` |
+``engine`` | ``emit``), the WAL (``wal``), and the columnar backend's
+submit/collect waves (``eng.submit`` / ``eng.collect``) stamp begin/end
+pairs carrying a *wave id* — one per worker batch, propagated
+thread-locally through the pipeline stages — plus per-kind attributes
+(frame/lane counts, chunk count, the submit->collect overlap).  Trace
+events record the wave they happened in, so :meth:`request_spans` /
+:meth:`request_breakdown` decompose one request into queue wait, device
+time, WAL fsync, and emit without rerunning the bench.
 """
 
 from __future__ import annotations
 
+import itertools
 import threading
 import time
 from collections import deque
-from typing import Dict, List, Tuple
+from typing import Dict, List, Optional, Tuple
 
 
 class RequestInstrumenter:
-    """Global trace ring; thread-safe, bounded."""
+    """Global trace + span rings; thread-safe, bounded."""
 
     enabled: bool = False
     _lock = threading.Lock()
-    _ring: "deque" = deque(maxlen=200_000)
+    _ring: "deque" = deque(maxlen=200_000)   # (req, stage, node, t, wave)
+    _spans: "deque" = deque(maxlen=50_000)   # completed span dicts
+    _tls = threading.local()
+    _wave_seq = itertools.count(1)
+    n_span_begun: int = 0
+    n_span_ended: int = 0
+
+    # -- wave plumbing -----------------------------------------------------
+
+    @classmethod
+    def next_wave(cls) -> int:
+        """Fresh process-global wave id (one per worker batch)."""
+        return next(cls._wave_seq)
+
+    @classmethod
+    def set_wave(cls, wave: int) -> None:
+        """Bind the calling thread to ``wave``: trace events and spans
+        recorded on this thread attach to it until rebound (the worker
+        hands the id across its pipeline stages along with the batch)."""
+        cls._tls.wave = wave
+
+    @classmethod
+    def current_wave(cls) -> int:
+        return getattr(cls._tls, "wave", 0)
+
+    # -- per-request trace events ------------------------------------------
 
     @classmethod
     def record(cls, req_id: int, stage: str, node: int) -> None:
         if not cls.enabled:
             return
         with cls._lock:
-            cls._ring.append((req_id, stage, node, time.monotonic()))
+            cls._ring.append((req_id, stage, node, time.monotonic(),
+                              getattr(cls._tls, "wave", 0)))
 
     @classmethod
     def trace(cls, req_id: int) -> List[Tuple[str, int, float]]:
         """(stage, node, t) events of one request, time-ordered."""
         with cls._lock:
-            evs = [(s, n, t) for r, s, n, t in cls._ring if r == req_id]
+            evs = [(s, n, t) for r, s, n, t, _w in cls._ring if r == req_id]
         return sorted(evs, key=lambda e: e[2])
 
     @classmethod
@@ -61,7 +98,87 @@ class RequestInstrumenter:
         return f"req {req_id:#x}: " + " ".join(
             f"{s}@n{n}+{(t - t0) * 1e3:.2f}ms" for s, n, t in evs)
 
+    # -- pipeline-stage spans ----------------------------------------------
+
+    @classmethod
+    def span_begin(cls, kind: str, node: int = -1,
+                   wave: Optional[int] = None, **attrs) -> Optional[dict]:
+        """Open a span of ``kind`` on the current (or given) wave.
+        Returns the span handle to pass to :meth:`span_end`, or None
+        when tracing is disabled (span_end accepts None)."""
+        if not cls.enabled:
+            return None
+        sp = {"kind": kind, "node": node,
+              "wave": cls.current_wave() if wave is None else wave,
+              "t0": time.monotonic(), "t1": None}
+        if attrs:
+            sp.update(attrs)
+        with cls._lock:
+            cls.n_span_begun += 1
+        return sp
+
+    @classmethod
+    def span_end(cls, sp: Optional[dict], **attrs) -> None:
+        if sp is None:
+            return
+        sp["t1"] = time.monotonic()
+        if attrs:
+            sp.update(attrs)
+        with cls._lock:
+            cls.n_span_ended += 1
+            cls._spans.append(sp)
+
+    @classmethod
+    def wave_spans(cls, wave: int) -> List[dict]:
+        """Completed spans of one wave, time-ordered."""
+        with cls._lock:
+            out = [dict(s) for s in cls._spans if s["wave"] == wave]
+        return sorted(out, key=lambda s: s["t0"])
+
+    @classmethod
+    def request_spans(cls, req_id: int) -> List[dict]:
+        """Pipeline-stage spans of every wave the request touched
+        (request frame decode, its engine+WAL batch, commit waves,
+        emit) — the per-request join of trace events and spans."""
+        with cls._lock:
+            waves = {w for r, _s, _n, _t, w in cls._ring
+                     if r == req_id and w}
+            out = [dict(s) for s in cls._spans if s["wave"] in waves]
+        return sorted(out, key=lambda s: s["t0"])
+
+    @classmethod
+    def request_breakdown(cls, req_id: int) -> Dict[str, float]:
+        """kind -> total seconds across the request's waves: decompose
+        a slow request into decode / engine / wal / emit /
+        eng.submit / eng.collect without rerunning the bench."""
+        out: Dict[str, float] = {}
+        for s in cls.request_spans(req_id):
+            out[s["kind"]] = out.get(s["kind"], 0.0) + (s["t1"] - s["t0"])
+        return out
+
+    @classmethod
+    def span_stats(cls) -> dict:
+        """Aggregate span view for the metrics snapshot: per-kind count
+        and total seconds, plus begin/end pairing counters (begun >
+        ended means spans are currently open — persistently growing
+        skew means a stage lost its end stamp)."""
+        with cls._lock:
+            agg: Dict[str, list] = {}
+            for s in cls._spans:
+                a = agg.setdefault(s["kind"], [0, 0.0])
+                a[0] += 1
+                a[1] += s["t1"] - s["t0"]
+            return {
+                "begun": cls.n_span_begun,
+                "ended": cls.n_span_ended,
+                "kinds": {k: {"count": c, "total_s": t}
+                          for k, (c, t) in sorted(agg.items())},
+            }
+
     @classmethod
     def clear(cls) -> None:
         with cls._lock:
             cls._ring.clear()
+            cls._spans.clear()
+            cls.n_span_begun = 0
+            cls.n_span_ended = 0

@@ -1,0 +1,230 @@
+"""Dependency-free Prometheus text exposition over the metrics dicts.
+
+Renders a node's ``metrics()`` dict (``paxos/manager.py``) — or the
+process-global profiler view for processes without a node, like the HTTP
+gateway — as Prometheus text format 0.0.4: ``# HELP``/``# TYPE`` once
+per metric, one sample per series, histogram tags as summaries with
+``quantile`` labels.  Kept deliberately tiny: the format is line-based
+and the scrape path must not grow a client-library dependency.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+_QUANTILES = (("0.5", "p50_s"), ("0.9", "p90_s"), ("0.99", "p99_s"),
+              ("0.999", "p999_s"))
+
+
+def _esc(v: str) -> str:
+    return str(v).replace("\\", r"\\").replace('"', r'\"') \
+        .replace("\n", r"\n")
+
+
+def _num(v) -> str:
+    if isinstance(v, bool):
+        return "1" if v else "0"
+    if isinstance(v, int):
+        return str(v)
+    return f"{float(v):.9g}"
+
+
+class _Writer:
+    """Accumulates one metric family at a time, guaranteeing the
+    HELP/TYPE-once and no-duplicate-series invariants by construction."""
+
+    def __init__(self):
+        self.lines: List[str] = []
+        self._seen: set = set()
+
+    def family(self, name: str, mtype: str, help_: str,
+               samples: List[Tuple[Optional[Dict[str, str]], object]],
+               ) -> None:
+        rows = []
+        for labels, value in samples:
+            if value is None:
+                continue
+            if labels:
+                lab = ",".join(f'{k}="{_esc(v)}"'
+                               for k, v in sorted(labels.items()))
+                series = f"{name}{{{lab}}}"
+            else:
+                series = name
+            if series in self._seen:
+                continue
+            self._seen.add(series)
+            rows.append(f"{series} {_num(value)}")
+        if not rows:
+            return
+        self.lines.append(f"# HELP {name} {help_}")
+        self.lines.append(f"# TYPE {name} {mtype}")
+        self.lines.extend(rows)
+
+    def summary(self, name: str, help_: str, label_key: str,
+                hists: Dict[str, dict]) -> None:
+        """A summary family (quantile/sum/count) per histogram tag."""
+        q_rows, sums, counts = [], [], []
+        for tag, h in sorted(hists.items()):
+            if not h.get("count"):
+                continue
+            for q, key in _QUANTILES:
+                q_rows.append(({label_key: tag, "quantile": q},
+                               h.get(key)))
+            sums.append(({label_key: tag}, h.get("sum_s")))
+            counts.append(({label_key: tag}, h.get("count")))
+        if not counts:
+            return
+        self.lines.append(f"# HELP {name} {help_}")
+        self.lines.append(f"# TYPE {name} summary")
+        for labels, value in q_rows:
+            if value is None:
+                continue
+            lab = ",".join(f'{k}="{_esc(v)}"'
+                           for k, v in sorted(labels.items()))
+            self.lines.append(f"{name}{{{lab}}} {_num(value)}")
+        for suffix, rows in (("_sum", sums), ("_count", counts)):
+            for labels, value in rows:
+                lab = ",".join(f'{k}="{_esc(v)}"'
+                               for k, v in sorted(labels.items()))
+                self.lines.append(f"{name}{suffix}{{{lab}}} {_num(value)}")
+
+    def render(self) -> str:
+        return "\n".join(self.lines) + "\n"
+
+
+def render_prometheus(m: dict, prefix: str = "gp") -> str:
+    """Metrics dict -> Prometheus text.  Tolerates partial dicts (the
+    gateway has no node counters; a bare profiler snapshot renders its
+    stages/rates/histograms only)."""
+    w = _Writer()
+    p = prefix
+
+    c = m.get("counters", {})
+    for key, help_ in (
+            ("executed", "requests executed by the app"),
+            ("decided", "paxos decisions reached"),
+            ("paused", "groups paused to the durable pause table"),
+            ("unpaused", "groups unpaused on demand"),
+            ("redriven", "accept re-drives (lost-Accept recovery)"),
+            ("redrive_capped", "re-drive ticks that hit the cap"),
+            ("parked", "proposals parked awaiting leadership"),
+            ("park_dropped", "parked proposals dropped at cap"),
+            ("shed", "requests answered retry by the backlog guard"),
+            ("installs", "coordinator installs won (failover)")):
+        if key in c:
+            w.family(f"{p}_{key}_total", "counter", help_,
+                     [(None, c[key])])
+    if "groups" in c:
+        w.family(f"{p}_groups", "gauge", "resident paxos groups",
+                 [(None, c["groups"])])
+    if "backlog_est" in c:
+        w.family(f"{p}_backlog_frames", "gauge",
+                 "estimated inbound backlog in frames",
+                 [(None, c["backlog_est"])])
+
+    eng = m.get("engine")
+    if eng is not None:
+        w.family(
+            f"{p}_engine_seconds_total", "counter",
+            "engine wave wall seconds: sub=host launching waves, "
+            "blk=host blocked materializing device results, "
+            "ovl=submit-to-collect gap won back",
+            [({"phase": "sub"}, eng.get("submit_s", 0.0)),
+             ({"phase": "blk"}, eng.get("collect_s", 0.0)),
+             ({"phase": "ovl"}, eng.get("overlap_s", 0.0))])
+
+    net = m.get("net", {})
+    for key, name, help_ in (
+            ("tx_frames", "net_tx_frames", "frames sent"),
+            ("tx_bytes", "net_tx_bytes", "bytes sent"),
+            ("rx_frames", "net_rx_frames", "frames received"),
+            ("rx_bytes", "net_rx_bytes", "bytes received"),
+            ("reconnects", "net_reconnects",
+             "peer reconnect attempts after a lost connection"),
+            ("connect_failures", "net_connect_failures",
+             "failed peer connect attempts")):
+        if key in net:
+            w.family(f"{p}_{name}_total", "counter", help_,
+                     [(None, net[key])])
+    drops = net.get("drops")
+    if drops:
+        w.family(f"{p}_net_dropped_frames_total", "counter",
+                 "outbound frames dropped, by cause",
+                 [({"cause": k}, v) for k, v in sorted(drops.items())])
+
+    prof = m.get("profiler", m if "totals" in m else {})
+    totals = prof.get("totals", {})
+    if totals:
+        w.family(f"{p}_stage_wall_seconds_total", "counter",
+                 "wall seconds accumulated per pipeline stage",
+                 [({"stage": t}, v.get("wall_s"))
+                  for t, v in sorted(totals.items())])
+        w.family(f"{p}_stage_cpu_seconds_total", "counter",
+                 "CPU seconds per stage (PC.PROFILE_CPU)",
+                 [({"stage": t}, v.get("cpu_s"))
+                  for t, v in sorted(totals.items())])
+        w.family(f"{p}_stage_calls_total", "counter",
+                 "calls per stage",
+                 [({"stage": t}, v.get("calls"))
+                  for t, v in sorted(totals.items())])
+        w.family(f"{p}_stage_items_total", "counter",
+                 "items per stage",
+                 [({"stage": t}, v.get("items"))
+                  for t, v in sorted(totals.items())])
+    rates = prof.get("rates", {})
+    if rates:
+        w.family(f"{p}_rate_per_second", "gauge",
+                 "windowed event rate per tag",
+                 [({"tag": t}, v.get("per_sec"))
+                  for t, v in sorted(rates.items())])
+        w.family(f"{p}_events_total", "counter",
+                 "cumulative event count per rate tag",
+                 [({"tag": t}, v.get("count"))
+                  for t, v in sorted(rates.items())])
+    hists = prof.get("histograms", {})
+    if hists:
+        w.summary(f"{p}_delay_seconds",
+                  "per-stage latency (log-bucketed histogram quantiles)",
+                  "stage", hists)
+
+    spans = m.get("spans", {})
+    kinds = spans.get("kinds", {})
+    if kinds:
+        w.family(f"{p}_span_seconds_total", "counter",
+                 "pipeline-stage span seconds by kind",
+                 [({"kind": k}, v.get("total_s"))
+                  for k, v in sorted(kinds.items())])
+        w.family(f"{p}_spans_total", "counter",
+                 "completed pipeline-stage spans by kind",
+                 [({"kind": k}, v.get("count"))
+                  for k, v in sorted(kinds.items())])
+    if spans:
+        w.family(f"{p}_spans_open", "gauge",
+                 "spans begun but not yet ended",
+                 [(None, max(0, spans.get("begun", 0)
+                             - spans.get("ended", 0)))])
+
+    return w.render()
+
+
+def process_metrics() -> dict:
+    """Process-global metrics for node-less processes (the HTTP
+    gateway): the profiler snapshot + span aggregates."""
+    from gigapaxos_tpu.utils.instrument import RequestInstrumenter
+    from gigapaxos_tpu.utils.profiler import DelayProfiler
+    return {"profiler": DelayProfiler.snapshot(),
+            "spans": RequestInstrumenter.span_stats()}
+
+
+def metrics_response(path: str, metrics_fn):
+    """Shared GET route body for the two observability endpoints (the
+    per-node listener and the HTTP gateway serve identical content):
+    ``(status, content_type, body)`` for /metrics | /stats, else None."""
+    if path == "/metrics":
+        return ("200 OK", "text/plain; version=0.0.4",
+                render_prometheus(metrics_fn()).encode())
+    if path == "/stats":
+        import json
+        return ("200 OK", "application/json",
+                json.dumps(metrics_fn(), default=str).encode())
+    return None

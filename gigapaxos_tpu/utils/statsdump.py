@@ -1,0 +1,54 @@
+"""Periodic stats dumper (ref: the reference's periodic DelayProfiler/
+NIOInstrumenter log lines from ``ReconfigurableNode``).
+
+One daemon thread per process: every ``interval_s`` it logs the node's
+one-line stats render and — when a ``jsonl_path`` is given — appends the
+full structured metrics snapshot as one JSON line, so a post-mortem has
+machine-readable history without a scraper having been attached.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Callable, Optional, Tuple
+
+from gigapaxos_tpu.utils.logutil import get_logger
+
+log = get_logger("gp.stats")
+
+
+class StatsDumper(threading.Thread):
+    """Calls ``source() -> (line, metrics_dict | None)`` every
+    ``interval_s``; logs the line, appends the dict to ``jsonl_path``
+    (append-only JSONL, one snapshot per line) when both are present."""
+
+    def __init__(self, source: Callable[[], Tuple[str, Optional[dict]]],
+                 interval_s: float, jsonl_path: Optional[str] = None,
+                 name: str = "gp-stats"):
+        super().__init__(daemon=True, name=name)
+        self._source = source
+        self.interval_s = float(interval_s)
+        self.jsonl_path = jsonl_path
+        # NOT named _stop: threading.Thread has an internal _stop()
+        # method that join() calls — shadowing it breaks join()
+        self._halt = threading.Event()
+
+    def run(self) -> None:
+        while not self._halt.wait(self.interval_s):
+            try:
+                line, m = self._source()
+                log.info("%s", line)
+                if self.jsonl_path and m is not None:
+                    rec = {"ts": round(time.time(), 3)}
+                    rec.update(m)
+                    with open(self.jsonl_path, "a") as f:
+                        f.write(json.dumps(rec, default=str) + "\n")
+            except Exception:  # a stats bug must never kill the node
+                log.exception("stats dump failed")
+
+    def stop(self, join_s: float = 2.0) -> None:
+        self._halt.set()
+        if self.is_alive():
+            self.join(join_s)

@@ -121,6 +121,34 @@ def render() -> str:
             f"{_fmt_ms(lp.get('lat_p99_ms'))} "
             "at depth 32 — one core shared by 3 nodes + client |")
 
+    # stage tails from the embedded end-of-run DelayProfiler snapshot
+    # (histogram p50/p99 per update_delay tag) — one artifact carries
+    # both the budget split and the tails, no re-run needed
+    prof = None
+    for key in ("config1_e2e_3r_1k_groups",
+                "config2_columnar_100k_groups_host_xla_knee"):
+        cand = row(key)
+        if cand and isinstance(cand["info"].get("profiler"), dict):
+            prof = (key, cand["info"]["profiler"])
+            break
+    if prof:
+        key, snap = prof
+        hists = snap.get("histograms", {})
+
+        def tail(tag):
+            h = hists.get(tag) or {}
+            if not h.get("count"):
+                return "n/a"
+            return (f"{1e3 * h['p50_s']:.2f} / "
+                    f"{1e3 * h['p99_s']:.2f} ms [{h['count']}]")
+
+        out.append(
+            "| Per-stage latency tails (p50 / p99 per call, from the "
+            f"`{key}` artifact's embedded profiler snapshot) | "
+            f"worker batch {tail('node.batch')}; WAL fsync "
+            f"{tail('wal.fsync')} — live on any node via `GET /metrics`"
+            " (see README Observability) |")
+
     r = row("config2_columnar_100k_groups_host_xla_knee")
     if r:
         i = r["info"]

@@ -31,6 +31,14 @@ def test_http_lifecycle(tmp_path):
             try:
                 st, out = await call(f"{base}/healthz")
                 assert st == 200 and out == b"ok\n"
+                # observability endpoints: Prometheus text + JSON
+                st, out = await call(f"{base}/metrics")
+                assert st == 200 and b"# TYPE " in out
+                assert all(ln.startswith(b"#") or b" " in ln
+                           for ln in out.splitlines() if ln)
+                st, out = await call(f"{base}/stats")
+                assert st == 200
+                assert "profiler" in json.loads(out)
                 st, out = await call(
                     f"{base}/create",
                     json.dumps({"name": "web1"}).encode())

@@ -1,0 +1,134 @@
+"""Exposition-format guard: scrape ``GET /metrics`` from an in-process
+node and fail on malformed lines, duplicate metric names, or duplicate
+series — keeps the dependency-free Prometheus text renderer honest —
+plus the ``/stats`` JSON schema and the acceptance-criteria content
+checks (decision counters, per-stage quantiles, eng sub/blk/ovl)."""
+
+import json
+import re
+import urllib.error
+import urllib.request
+
+from gigapaxos_tpu.paxos.client import PaxosClient
+from gigapaxos_tpu.paxos.interfaces import NoopApp
+from gigapaxos_tpu.paxos.manager import PaxosNode
+from gigapaxos_tpu.paxos.paxosconfig import PC
+from gigapaxos_tpu.testing.harness import free_ports
+from gigapaxos_tpu.utils.config import Config
+from tests.conftest import tscale
+
+# metric_name{label="value",...} <float>
+_SAMPLE = re.compile(
+    r'^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)'
+    r'(?P<labels>\{[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*"'
+    r'(?:,[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*")*\})?'
+    r' (?P<value>[-+]?(?:[0-9]*\.?[0-9]+(?:[eE][-+]?[0-9]+)?'
+    r'|NaN|[+-]?Inf))$')
+
+
+def _get(port, path):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=15) as r:
+        return r.status, r.read()
+
+
+def _validate_exposition(text: str) -> dict:
+    """Returns {series: value}; asserts the format invariants."""
+    typed, helped, series = {}, set(), {}
+    for ln in text.splitlines():
+        if not ln.strip():
+            continue
+        if ln.startswith("# HELP "):
+            name = ln.split()[2]
+            assert name not in helped, f"duplicate HELP for {name}"
+            helped.add(name)
+            continue
+        if ln.startswith("# TYPE "):
+            _, _, name, mtype = ln.split(None, 3)
+            assert name not in typed, f"duplicate TYPE for {name}"
+            assert mtype in ("counter", "gauge", "summary", "histogram")
+            typed[name] = mtype
+            continue
+        assert not ln.startswith("#"), f"unknown comment: {ln!r}"
+        m = _SAMPLE.match(ln)
+        assert m, f"malformed sample line: {ln!r}"
+        key = ln.rsplit(" ", 1)[0]
+        assert key not in series, f"duplicate series: {key}"
+        series[key] = float(m.group("value"))
+        base = m.group("name")
+        # every sample belongs to a declared family (summaries add
+        # _sum/_count to the declared base name)
+        ok = base in typed or any(
+            base == f"{n}{suf}" and t == "summary"
+            for n, t in typed.items() for suf in ("_sum", "_count"))
+        assert ok, f"sample {base} has no TYPE declaration"
+    assert series, "empty exposition"
+    return series
+
+
+def test_metrics_and_stats_endpoints(tmp_path):
+    Config.set(PC.STATS_PORT, 0)  # ephemeral per-node stats listener
+    addr = {0: ("127.0.0.1", free_ports(1)[0])}
+    node = PaxosNode(0, addr, NoopApp(), str(tmp_path), backend="native")
+    node.start()
+    try:
+        assert node.create_group("obs", (0,))
+        cli = PaxosClient([addr[0]], timeout=tscale(10))
+        for k in range(5):
+            assert cli.send_request("obs", f"x{k}".encode()).status == 0
+        cli.close()
+        port = node.stats_http.port
+
+        st, body = _get(port, "/healthz")
+        assert st == 200 and body == b"ok\n"
+
+        st, body = _get(port, "/metrics")
+        assert st == 200
+        series = _validate_exposition(body.decode())
+
+        # acceptance-criteria content: decision counters, engine
+        # sub/blk/ovl totals, per-stage histogram quantiles
+        assert series["gp_decided_total"] >= 5
+        assert series["gp_executed_total"] >= 5
+        for phase in ("sub", "blk", "ovl"):
+            assert f'gp_engine_seconds_total{{phase="{phase}"}}' \
+                in series
+        for q in ("0.5", "0.99"):
+            assert (f'gp_delay_seconds{{quantile="{q}",'
+                    f'stage="node.batch"}}') in series
+        assert 'gp_net_dropped_frames_total{cause="congestion"}' \
+            in series
+
+        # /stats carries the same data as JSON
+        st, body = _get(port, "/stats")
+        assert st == 200
+        m = json.loads(body)
+        assert {"counters", "engine", "net", "profiler",
+                "spans"} <= set(m)
+        assert m["counters"]["decided"] >= 5
+        assert m["profiler"]["histograms"]["node.batch"]["p50_s"] > 0
+        assert set(m["engine"]) == {"submit_s", "collect_s",
+                                    "overlap_s"}
+
+        st, body = _get(port, "/metrics")  # scrape twice: stable
+        _validate_exposition(body.decode())
+        try:
+            _get(port, "/nope")
+            assert False, "expected 404"
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+    finally:
+        node.stop()
+
+
+def test_render_tolerates_partial_metrics():
+    """The renderer handles a bare profiler snapshot (the gateway has
+    no node counters) and still emits valid text."""
+    from gigapaxos_tpu.utils.prom import render_prometheus
+    from gigapaxos_tpu.utils.profiler import DelayProfiler
+    import time
+    DelayProfiler.clear()
+    DelayProfiler.update_delay('we"ird\ntag', time.monotonic() - 0.001)
+    text = render_prometheus(
+        {"profiler": DelayProfiler.snapshot(), "spans": {}})
+    _validate_exposition(text)  # label escaping keeps lines parseable

@@ -141,8 +141,21 @@ def capture_ondevice(timeout_s: int = 900) -> dict:
             with open(tmp, "w") as f:
                 json.dump(out, f)
             os.replace(tmp, path)
-            return {"ondevice": "ok",
-                    "ondevice_wall_s": round(time.time() - t0, 1)}
+            rec = {"ondevice": "ok",
+                   "ondevice_wall_s": round(time.time() - t0, 1)}
+            # tails straight into the probe timeline: the captured
+            # artifact embeds the run's profiler snapshot, so a reader
+            # scanning the JSONL sees e2e and WAL p99 without opening
+            # the artifact
+            info = out.get("info", {})
+            lp = info.get("latency_point", {})
+            if lp.get("lat_p99_ms") is not None:
+                rec["ondevice_p99_ms"] = lp["lat_p99_ms"]
+            wal = (info.get("profiler", {}).get("histograms", {})
+                   .get("wal.fsync", {}))
+            if wal.get("p99_s") is not None:
+                rec["ondevice_wal_p99_ms"] = round(1e3 * wal["p99_s"], 2)
+            return rec
         return {"ondevice": "rc_%d" % res.returncode,
                 "ondevice_wall_s": round(time.time() - t0, 1)}
     except subprocess.TimeoutExpired:
