@@ -272,20 +272,26 @@ def _baseline_pipeline(make_backend, G, W, B, iters):
 
 
 def bench_e2e_runtime(n_requests: int = 6000, groups: int = 1000,
-                      depth: int = 448) -> dict:
+                      depth: int = 448, backend: str = "native",
+                      engine_shards: int = 1) -> dict:
     """A compact end-to-end runtime measurement (BASELINE.md names "p99
     accept→decide"; the client-observed request→reply latency is its
     honest end-to-end superset): 3 real nodes over loopback sockets,
     native engine, dual operating points — deep pipeline for
-    throughput, depth-32 for latency percentiles."""
+    throughput, depth-32 for latency percentiles.  ``engine_shards``
+    (columnar only) measures the row-sharded lane scale-up point."""
     import shutil
     import tempfile
 
     from gigapaxos_tpu.testing.harness import PaxosEmulation
+    from gigapaxos_tpu.utils.config import Config
+    from gigapaxos_tpu.paxos.paxosconfig import PC
 
     logdir = tempfile.mkdtemp(prefix="gp_bench_e2e_")
+    prev_shards = int(Config.get(PC.ENGINE_SHARDS))
+    Config.set(PC.ENGINE_SHARDS, engine_shards)
     emu = PaxosEmulation(logdir, n_nodes=3, n_groups=groups,
-                         backend="native")
+                         backend=backend)
     try:
         from gigapaxos_tpu.utils.profiler import DelayProfiler
         emu.run_load_fast(1000, concurrency=depth)  # warmup
@@ -294,6 +300,7 @@ def bench_e2e_runtime(n_requests: int = 6000, groups: int = 1000,
                                 client_id=1 << 22)
         return {
             "replicas": 3, "groups": groups,
+            "backend": backend, "engine_shards": engine_shards,
             "deep": {"concurrency": depth,
                      "throughput_rps": deep["throughput_rps"],
                      "ok": deep["ok"], "errors": deep["errors"]},
@@ -307,6 +314,7 @@ def bench_e2e_runtime(n_requests: int = 6000, groups: int = 1000,
         }
     finally:
         emu.stop()
+        Config.set(PC.ENGINE_SHARDS, prev_shards)
         shutil.rmtree(logdir, ignore_errors=True)
 
 
@@ -680,6 +688,12 @@ def _record_tpu_last_good(line: str) -> None:
 
 
 def run_bench(args) -> dict:
+    # capture the session's lane count NOW: bench_e2e_runtime's A/B
+    # points set and then RESET the knob, so a read after them would
+    # always record 1 regardless of what this process served with
+    from gigapaxos_tpu.utils.config import Config as _Cfg
+    from gigapaxos_tpu.paxos.paxosconfig import PC as _PC
+    _shards_cfg = int(_Cfg.get(_PC.ENGINE_SHARDS))
     cps, info = bench_columnar(args.groups, args.window, args.batch,
                                args.iters, args.warmup, args.trials)
     nps = bench_native_baseline(args.baseline_groups, args.window,
@@ -712,8 +726,34 @@ def run_bench(args) -> dict:
                                     groups=200 if args.quick else 1000)
         except Exception as exc:  # pragma: no cover - env-dependent
             e2e = {"error": repr(exc)}
+        # sharded-lane scale-up A/B (columnar S=1 vs S=min(4, cores)):
+        # only meaningful where lanes can land on distinct cores — the
+        # 1-2 core CI box records the S=1 baseline above untouched and
+        # skips this point, so the perf trajectory stays interpretable
+        # (info records engine_shards + host_cpus either way)
+        cpus = os.cpu_count() or 1
+        if cpus >= 4 and not args.quick:
+            try:
+                n_sh = 1200
+                s1 = bench_e2e_runtime(n_sh, groups=200, depth=256,
+                                       backend="columnar",
+                                       engine_shards=1)
+                s_n = bench_e2e_runtime(n_sh, groups=200, depth=256,
+                                        backend="columnar",
+                                        engine_shards=min(4, cpus))
+                e2e["sharded"] = {
+                    "engine_shards": min(4, cpus),
+                    "columnar_s1_rps": s1["deep"]["throughput_rps"],
+                    "columnar_sN_rps": s_n["deep"]["throughput_rps"],
+                    "speedup": round(
+                        s_n["deep"]["throughput_rps"]
+                        / max(s1["deep"]["throughput_rps"], 1e-9), 2),
+                }
+            except Exception as exc:  # pragma: no cover
+                e2e["sharded"] = {"error": repr(exc)}
     import jax
     info.update(platform=jax.devices()[0].platform,
+                engine_shards=_shards_cfg,
                 host_cpus=os.cpu_count(),
                 native_baseline_dps=round(nps),
                 python_oracle_dps=round(pys),

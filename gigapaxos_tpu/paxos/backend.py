@@ -474,11 +474,12 @@ class EngineWave:
     ``eng.collect`` DelayProfiler total, with the submit->collect gap
     (the overlap the caller actually won) under ``eng.overlap``."""
 
-    __slots__ = ("_finish", "_n", "_submitted", "_wave")
+    __slots__ = ("_finish", "_n", "_submitted", "_wave", "_sfx")
 
-    def __init__(self, finish: Callable, n: int):
+    def __init__(self, finish: Callable, n: int, sfx: str = ""):
         self._finish = finish
         self._n = n
+        self._sfx = sfx  # "@<shard>" on a sharded lane's slab, else ""
         self._submitted = time.monotonic()
         # bind the wave id at submit: collect may run after the worker
         # thread has moved on to a later batch's wave
@@ -488,6 +489,9 @@ class EngineWave:
         t0 = time.monotonic()
         overlap = t0 - self._submitted
         DelayProfiler.add_total("eng.overlap", overlap, self._n)
+        if self._sfx:
+            DelayProfiler.add_total("eng.overlap" + self._sfx, overlap,
+                                    self._n)
         # span duration = host blocked materializing; overlap_s attr =
         # the device-ran-while-host-worked gap — the device-vs-host
         # split of the wave, queryable per request
@@ -497,6 +501,9 @@ class EngineWave:
         res = self._finish()
         RequestInstrumenter.span_end(sp)
         DelayProfiler.update_total("eng.collect", t0, self._n)
+        if self._sfx:
+            DelayProfiler.update_total("eng.collect" + self._sfx, t0,
+                                       self._n)
         return res
 
 
@@ -530,7 +537,13 @@ class ColumnarBackend(AcceptorBackend):
 
     def __init__(self, capacity: int, window: int = 16,
                  use_pallas_accept: Optional[bool] = None,
-                 mesh=None):
+                 mesh=None, prof_suffix: str = ""):
+        # mesh: a Mesh object pins sharding; None means "auto" per
+        # PC.COLUMNAR_MESH; the string "off" forces single-device (the
+        # engine-lane slabs use it — lane-level parallelism replaces
+        # mesh parallelism, and S slab meshes would serialize on the
+        # process-wide cpu-mesh dispatch lock).  prof_suffix ("@<k>")
+        # labels this slab's profiler tags with its shard.
         import jax
 
         from gigapaxos_tpu.ops import kernels, make_state
@@ -557,6 +570,10 @@ class ColumnarBackend(AcceptorBackend):
         # suites exercise this path, not just the storm dryrun.
         from gigapaxos_tpu.utils.config import Config as _Cfg
         from gigapaxos_tpu.paxos.paxosconfig import PC as _PC
+        self._sfx = prof_suffix
+        mesh_auto_ok = mesh != "off"
+        if mesh == "off":
+            mesh = None
         self._mesh = mesh
         self._repl = None
         # runtime device pinning (PC.COLUMNAR_DEVICE): the node runtime
@@ -580,7 +597,7 @@ class ColumnarBackend(AcceptorBackend):
                 devs = jax.local_devices()  # no cpu backend: default
         else:
             devs = jax.local_devices()
-        if self._mesh is None and \
+        if self._mesh is None and mesh_auto_ok and \
                 str(_Cfg.get(_PC.COLUMNAR_MESH)) == "auto" and \
                 len(devs) > 1 and capacity % len(devs) == 0:
             from jax.sharding import Mesh
@@ -743,6 +760,8 @@ class ColumnarBackend(AcceptorBackend):
             outs.append((o, m))
         RequestInstrumenter.span_end(sp, chunks=len(outs))
         DelayProfiler.update_total("eng.submit", t0, n)
+        if self._sfx:
+            DelayProfiler.update_total("eng.submit" + self._sfx, t0, n)
         return outs
 
     # -- ops ---------------------------------------------------------------
@@ -783,7 +802,7 @@ class ColumnarBackend(AcceptorBackend):
                 np.asarray(slots, np.int32), np.asarray(bals, np.int32),
                 lo, hi, np.ones(n, bool))
             res = AcceptRes(acked, stale, ow, cur_bal)
-            return EngineWave(lambda: res, n)
+            return EngineWave(lambda: res, n, self._sfx)
         outs = self._submit1(self._k.accept_p, n, [
             (rows, 0), (slots, NO_SLOT), (bals, NO_BALLOT), (lo, 0),
             (hi, 0)])
@@ -792,7 +811,7 @@ class ColumnarBackend(AcceptorBackend):
             out = _collect_cols(outs)
             return AcceptRes(out[0] != 0, out[1] != 0, out[2] != 0,
                              out[3])
-        return EngineWave(finish, n)
+        return EngineWave(finish, n, self._sfx)
 
     def accept(self, rows, slots, bals, req_ids) -> AcceptRes:
         return self.accept_submit(rows, slots, bals, req_ids).collect()
@@ -812,7 +831,7 @@ class ColumnarBackend(AcceptorBackend):
                 newly, out[1] != 0, np.where(newly, out[3], 0),
                 np.where(newly, out[4], 0),
                 np.where(newly, out[2], NO_BALLOT))
-        return EngineWave(finish, n)
+        return EngineWave(finish, n, self._sfx)
 
     def accept_reply(self, rows, slots, bals, senders, acked
                      ) -> AcceptReplyRes:
@@ -839,7 +858,7 @@ class ColumnarBackend(AcceptorBackend):
             out = _collect_cols(outs)
             return CommitRes(out[0] != 0, out[1] != 0, out[2] != 0,
                              out[3])
-        return EngineWave(finish, n)
+        return EngineWave(finish, n, self._sfx)
 
     def commit(self, rows, slots, req_ids) -> CommitRes:
         return self.commit_submit(rows, slots, req_ids).collect()
@@ -873,6 +892,9 @@ class ColumnarBackend(AcceptorBackend):
             outs2.append((o2, b2 - a2))
         RequestInstrumenter.span_end(sp, chunks=len(outs1))
         DelayProfiler.update_total("eng.submit", t0, n1 + n2)
+        if self._sfx:
+            DelayProfiler.update_total("eng.submit" + self._sfx, t0,
+                                       n1 + n2)
         return outs1, outs2
 
     def accept_commit_submit(self, rows_a, slots_a, bals_a, reqs_a,
@@ -888,7 +910,7 @@ class ColumnarBackend(AcceptorBackend):
             res = AcceptorBackend.accept_commit(
                 self, rows_a, slots_a, bals_a, reqs_a, rows_c, slots_c,
                 reqs_c)
-            return EngineWave(lambda: res, na + nc)
+            return EngineWave(lambda: res, na + nc, self._sfx)
         lo_a, hi_a = _split64(reqs_a)
         lo_c, hi_c = _split64(reqs_c)
         outs_a, outs_c = self._submit2(
@@ -903,7 +925,7 @@ class ColumnarBackend(AcceptorBackend):
             c = _collect_cols(outs_c)
             return (AcceptRes(a[0] != 0, a[1] != 0, a[2] != 0, a[3]),
                     CommitRes(c[0] != 0, c[1] != 0, c[2] != 0, c[3]))
-        return EngineWave(finish, na + nc)
+        return EngineWave(finish, na + nc, self._sfx)
 
     def accept_commit(self, rows_a, slots_a, bals_a, reqs_a,
                       rows_c, slots_c, reqs_c
@@ -978,7 +1000,7 @@ class ColumnarBackend(AcceptorBackend):
                 np.where(newly, r[4], 0),
                 np.where(newly, r[2], NO_BALLOT)), r[6] != 0, r[7] != 0)
             return pres, rres
-        return EngineWave(finish, np_ + nr)
+        return EngineWave(finish, np_ + nr, self._sfx)
 
     def propose_self_reply(self, rows_p, reqs_p, self_midx,
                            rows_r, slots_r, bals_r, senders_r, acked_r):
@@ -1088,3 +1110,365 @@ class ColumnarBackend(AcceptorBackend):
             self.state, _ = scatter_rows(
                 self.state, self._dev(np.asarray([row], np.int32)),
                 row_state, self._dev(np.asarray([True])))
+
+
+# --------------------------------------------------------------------------
+# sharded columnar backend (row-partitioned engine lanes)
+# --------------------------------------------------------------------------
+
+
+class _MergedWave:
+    """Collectable handle over one in-flight wave per shard slab — the
+    sharded analog of :class:`EngineWave`.  ``collect()`` drains every
+    slab's wave and scatters the per-shard results back into input lane
+    order."""
+
+    __slots__ = ("_waves", "_merge")
+
+    def __init__(self, waves: List, merge: Callable):
+        self._waves = waves  # [(shard, idx, wave)]
+        self._merge = merge
+
+    def collect(self):
+        return self._merge([(k, idx, w.collect())
+                            for k, idx, w in self._waves])
+
+
+def _scatter_res(parts: List[Tuple[np.ndarray, tuple]], n: int):
+    """Merge per-shard result tuples (NamedTuple or plain tuple of
+    arrays, 1-D ``[B]`` or 2-D ``[B, W]``) back into input lane order.
+    ``parts`` is ``[(idx, res), ...]`` with ``idx`` the global lane
+    indices the shard served."""
+    first = parts[0][1]
+    fields = []
+    for fi in range(len(first)):
+        f0 = np.asarray(first[fi])
+        out = np.empty((n,) + f0.shape[1:], f0.dtype)
+        for idx, res in parts:
+            out[idx] = np.asarray(res[fi])
+        fields.append(out)
+    return type(first)(*fields) if hasattr(first, "_fields") \
+        else tuple(fields)
+
+
+class ShardedColumnarBackend(AcceptorBackend):
+    """S independent :class:`ColumnarBackend` slabs behind the single
+    ``AcceptorBackend`` SPI (PC.ENGINE_SHARDS; the row-sharded engine
+    lanes tentpole).
+
+    Global row ``r`` lives in slab ``r % S`` at local row ``r // S`` —
+    the interleaved mapping matches ``GroupTable``'s per-shard free
+    lists, so a group (shard = ``gkey % S``) always resolves to its
+    shard's slab.  Every SPI call splits its lanes by shard, drives
+    each slab with local rows, and scatters results back into input
+    order; a lane-pure batch (the manager's per-lane workers only ever
+    send their own shard's rows) degenerates to one slab call plus an
+    ``arange`` scatter.  Slabs are single-device (mesh "off"): lane
+    parallelism replaces mesh parallelism, and S sharded host-XLA
+    programs would serialize on the process-wide cpu-mesh dispatch
+    lock anyway.  Each slab's profiler tags carry an ``@<shard>``
+    suffix next to the node-wide base tags.
+    """
+
+    def __init__(self, capacity: int, window: int = 16, shards: int = 2,
+                 use_pallas_accept: Optional[bool] = None):
+        if capacity % shards:
+            raise ValueError(
+                f"capacity {capacity} not divisible by shards {shards}")
+        self.capacity = capacity
+        self._window = window
+        self.shards = shards
+        self.slabs = [
+            ColumnarBackend(capacity // shards, window,
+                            use_pallas_accept=use_pallas_accept,
+                            mesh="off", prof_suffix=f"@{k}")
+            for k in range(shards)]
+        self.engine_platform = self.slabs[0].engine_platform
+
+    @property
+    def window(self) -> int:
+        return self._window
+
+    # -- shard split helpers ----------------------------------------------
+
+    def _split(self, rows) -> List[Tuple[int, np.ndarray, np.ndarray]]:
+        """(shard, global lane idx, local rows) per shard present."""
+        rows = np.asarray(rows)
+        if not len(rows):
+            return []
+        sh = rows.astype(np.int64) % self.shards
+        lo = sh.min()
+        if lo == sh.max():  # lane-pure batch (the per-lane worker path)
+            return [(int(lo), np.arange(len(rows)),
+                     (rows // self.shards).astype(np.int32))]
+        out = []
+        for k in range(self.shards):
+            idx = np.flatnonzero(sh == k)
+            if len(idx):
+                out.append((k, idx,
+                            (rows[idx] // self.shards).astype(np.int32)))
+        return out
+
+    @staticmethod
+    def _cols_at(cols: tuple, idx: np.ndarray, n: int) -> list:
+        """Slice the batch columns down to one shard's lanes — skipping
+        the fancy-index copy entirely on a lane-pure batch (idx is the
+        identity there, and per-lane workers only ever send lane-pure
+        batches, so the hot path pays zero slicing)."""
+        if len(idx) == n:
+            return [np.asarray(c) for c in cols]
+        return [np.asarray(c)[idx] for c in cols]
+
+    def _fan1(self, op: str, rows, cols: tuple):
+        """Split-call-merge for single-input ops whose slab method takes
+        ``(local_rows, *cols)`` and returns a result tuple aligned to
+        its lanes."""
+        rows = np.asarray(rows)
+        n = len(rows)
+        parts = []
+        for k, idx, local in self._split(rows):
+            args = self._cols_at(cols, idx, n)
+            parts.append((idx, getattr(self.slabs[k], op)(local, *args)))
+        if not parts:
+            # keep the result structure for empty input (slab handles
+            # zero-length arrays)
+            return getattr(self.slabs[0], op)(
+                rows.astype(np.int32), *[np.asarray(c) for c in cols])
+        if len(parts) == 1 and len(parts[0][0]) == n:
+            return parts[0][1]
+        return _scatter_res(parts, n)
+
+    # -- SPI ---------------------------------------------------------------
+
+    def create(self, rows, members, versions, init_bal, self_coord):
+        for k, idx, local in self._split(rows):
+            self.slabs[k].create(local, np.asarray(members)[idx],
+                                 np.asarray(versions)[idx],
+                                 np.asarray(init_bal)[idx],
+                                 np.asarray(self_coord)[idx])
+
+    def delete(self, rows):
+        for k, _idx, local in self._split(rows):
+            self.slabs[k].delete(local)
+
+    def accept(self, rows, slots, bals, req_ids) -> AcceptRes:
+        return self._fan1("accept", rows, (slots, bals, req_ids))
+
+    def accept_submit(self, rows, slots, bals, req_ids):
+        return self._submit_fan("accept_submit", rows,
+                                (slots, bals, req_ids))
+
+    def accept_reply(self, rows, slots, bals, senders, acked
+                     ) -> AcceptReplyRes:
+        return self._fan1("accept_reply", rows,
+                          (slots, bals, senders, acked))
+
+    def accept_reply_submit(self, rows, slots, bals, senders, acked):
+        return self._submit_fan("accept_reply_submit", rows,
+                                (slots, bals, senders, acked))
+
+    def propose(self, rows, req_ids) -> ProposeRes:
+        return self._fan1("propose", rows, (req_ids,))
+
+    def commit(self, rows, slots, req_ids) -> CommitRes:
+        return self._fan1("commit", rows, (slots, req_ids))
+
+    def commit_submit(self, rows, slots, req_ids):
+        return self._submit_fan("commit_submit", rows, (slots, req_ids))
+
+    def prepare(self, rows, bals) -> PrepareRes:
+        return self._fan1("prepare", rows, (bals,))
+
+    def _submit_fan(self, op: str, rows, cols: tuple) -> _MergedWave:
+        """Submit one wave per shard present (all launched before any
+        collect — the cross-slab overlap), merged at collect()."""
+        rows = np.asarray(rows)
+        n = len(rows)
+        waves = []
+        for k, idx, local in self._split(rows):
+            args = self._cols_at(cols, idx, n)
+            waves.append((k, idx, getattr(self.slabs[k], op)(local,
+                                                             *args)))
+        if not waves:
+            waves = [(0, np.arange(0),
+                      getattr(self.slabs[0], op)(
+                          rows.astype(np.int32),
+                          *[np.asarray(c) for c in cols]))]
+
+        def merge(done):
+            if len(done) == 1 and len(done[0][1]) == n:
+                return done[0][2]
+            return _scatter_res([(idx, res) for _k, idx, res in done], n)
+        return _MergedWave(waves, merge)
+
+    def propose_self(self, rows, req_ids, self_midx):
+        rows = np.asarray(rows)
+        n = len(rows)
+        parts = []
+        for k, idx, local in self._split(rows):
+            reqs_k, midx_k = self._cols_at((req_ids, self_midx), idx, n)
+            pr, sa, sn, sp, sc = self.slabs[k].propose_self(
+                local, reqs_k, midx_k)
+            parts.append((idx, tuple(pr) + (sa, sn, sp, sc)))
+        if not parts:
+            return self.slabs[0].propose_self(
+                rows.astype(np.int32), np.asarray(req_ids),
+                np.asarray(self_midx))
+        if len(parts) == 1 and len(parts[0][0]) == n:
+            flat = parts[0][1]
+        else:
+            flat = _scatter_res(parts, n)
+        return (ProposeRes(*flat[:5]), flat[5], flat[6], flat[7],
+                flat[8])
+
+    def accept_reply_commit_self(self, rows, slots, bals, senders,
+                                 acked):
+        rows = np.asarray(rows)
+        n = len(rows)
+        parts = []
+        for k, idx, local in self._split(rows):
+            sl_k, b_k, sd_k, ak_k = self._cols_at(
+                (slots, bals, senders, acked), idx, n)
+            res, app, st = self.slabs[k].accept_reply_commit_self(
+                local, sl_k, b_k, sd_k, ak_k)
+            parts.append((idx, tuple(res) + (app, st)))
+        if not parts:
+            return self.slabs[0].accept_reply_commit_self(
+                rows.astype(np.int32), np.asarray(slots),
+                np.asarray(bals), np.asarray(senders),
+                np.asarray(acked))
+        if len(parts) == 1 and len(parts[0][0]) == n:
+            flat = parts[0][1]
+        else:
+            flat = _scatter_res(parts, n)
+        return AcceptReplyRes(*flat[:5]), flat[5], flat[6]
+
+    def accept_commit_submit(self, rows_a, slots_a, bals_a, reqs_a,
+                             rows_c, slots_c, reqs_c) -> _MergedWave:
+        """Fused acceptor wave across slabs: each shard present in
+        EITHER half gets ONE slab dispatch covering its share of both
+        (empty halves ride along as zero-lane inputs, preserving the
+        slab's accepts-then-commits ordering)."""
+        rows_a, rows_c = np.asarray(rows_a), np.asarray(rows_c)
+        na, nc = len(rows_a), len(rows_c)
+        pa = {k: (idx, local) for k, idx, local in self._split(rows_a)}
+        pc = {k: (idx, local) for k, idx, local in self._split(rows_c)}
+        e_i, e_r = np.arange(0), np.zeros(0, np.int32)
+        waves = []
+        for k in sorted(set(pa) | set(pc)) or [0]:
+            ai, al = pa.get(k, (e_i, e_r))
+            ci, cl = pc.get(k, (e_i, e_r))
+            sa_k, ba_k, ra_k = self._cols_at((slots_a, bals_a, reqs_a),
+                                             ai, na)
+            sc_k, rc_k = self._cols_at((slots_c, reqs_c), ci, nc)
+            w = self.slabs[k].accept_commit_submit(
+                al, sa_k, ba_k, ra_k, cl, sc_k, rc_k)
+            waves.append((k, (ai, ci), w))
+
+        def merge(done):
+            if len(done) == 1:
+                ai, ci = done[0][1]
+                if len(ai) == na and len(ci) == nc:
+                    return done[0][2]  # lane-pure: no scatter needed
+            a_parts = [(ai, res[0]) for (_k, (ai, _ci), res) in done
+                       if len(ai)]
+            c_parts = [(ci, res[1]) for (_k, (_ai, ci), res) in done
+                       if len(ci)]
+            ares = _scatter_res(a_parts, na) if a_parts \
+                else done[0][2][0]
+            cres = _scatter_res(c_parts, nc) if c_parts \
+                else done[0][2][1]
+            return ares, cres
+        return _MergedWave(waves, merge)
+
+    def accept_commit(self, rows_a, slots_a, bals_a, reqs_a,
+                      rows_c, slots_c, reqs_c):
+        return self.accept_commit_submit(
+            rows_a, slots_a, bals_a, reqs_a, rows_c, slots_c,
+            reqs_c).collect()
+
+    def propose_self_reply_submit(self, rows_p, reqs_p, self_midx,
+                                  rows_r, slots_r, bals_r, senders_r,
+                                  acked_r) -> _MergedWave:
+        rows_p, rows_r = np.asarray(rows_p), np.asarray(rows_r)
+        n_p, n_r = len(rows_p), len(rows_r)
+        pp = {k: (idx, local) for k, idx, local in self._split(rows_p)}
+        pr = {k: (idx, local) for k, idx, local in self._split(rows_r)}
+        e_i, e_r = np.arange(0), np.zeros(0, np.int32)
+        waves = []
+        for k in sorted(set(pp) | set(pr)) or [0]:
+            pi, pl = pp.get(k, (e_i, e_r))
+            ri, rl = pr.get(k, (e_i, e_r))
+            rq_k, mi_k = self._cols_at((reqs_p, self_midx), pi, n_p)
+            sr_k, br_k, se_k, ak_k = self._cols_at(
+                (slots_r, bals_r, senders_r, acked_r), ri, n_r)
+            w = self.slabs[k].propose_self_reply_submit(
+                pl, rq_k, mi_k, rl, sr_k, br_k, se_k, ak_k)
+            waves.append((k, (pi, ri), w))
+
+        def merge(done):
+            if len(done) == 1:
+                pi, ri = done[0][1]
+                if len(pi) == n_p and len(ri) == n_r:
+                    return done[0][2]  # lane-pure: no scatter needed
+            p_parts = [(pi, tuple(res[0][0]) + tuple(res[0][1:]))
+                       for (_k, (pi, _ri), res) in done if len(pi)]
+            r_parts = [(ri, tuple(res[1][0]) + tuple(res[1][1:]))
+                       for (_k, (_pi, ri), res) in done if len(ri)]
+            if p_parts:
+                pf = _scatter_res(p_parts, n_p)
+                pres = (ProposeRes(*pf[:5]), pf[5], pf[6], pf[7], pf[8])
+            else:
+                pres = done[0][2][0]
+            if r_parts:
+                rf = _scatter_res(r_parts, n_r)
+                rres = (AcceptReplyRes(*rf[:5]), rf[5], rf[6])
+            else:
+                rres = done[0][2][1]
+            return pres, rres
+        return _MergedWave(waves, merge)
+
+    def propose_self_reply(self, rows_p, reqs_p, self_midx,
+                           rows_r, slots_r, bals_r, senders_r, acked_r):
+        return self.propose_self_reply_submit(
+            rows_p, reqs_p, self_midx, rows_r, slots_r, bals_r,
+            senders_r, acked_r).collect()
+
+    def install_coordinator(self, rows, cbals, next_slots, carry_slot,
+                            carry_req) -> None:
+        for k, idx, local in self._split(rows):
+            self.slabs[k].install_coordinator(
+                local, np.asarray(cbals)[idx],
+                np.asarray(next_slots)[idx],
+                np.asarray(carry_slot)[idx],
+                np.asarray(carry_req)[idx])
+
+    def set_cursor(self, rows, cursors, next_slots) -> None:
+        for k, idx, local in self._split(rows):
+            self.slabs[k].set_cursor(local, np.asarray(cursors)[idx],
+                                     np.asarray(next_slots)[idx])
+
+    def gc(self, rows, upto) -> None:
+        for k, idx, local in self._split(rows):
+            self.slabs[k].gc(local, np.asarray(upto)[idx])
+
+    def cursor_of(self, row: int) -> int:
+        return self.slabs[row % self.shards].cursor_of(
+            row // self.shards)
+
+    def snapshot_row(self, row: int) -> dict:
+        return self.slabs[row % self.shards].snapshot_row(
+            row // self.shards)
+
+    def snapshot_rows(self, rows) -> List[dict]:
+        rows = np.asarray(rows)
+        out: List[Optional[dict]] = [None] * len(rows)
+        for k, idx, local in self._split(rows):
+            for i, snap in zip(idx.tolist(),
+                               self.slabs[k].snapshot_rows(local)):
+                out[i] = snap
+        return out
+
+    def restore_row(self, row: int, snap: dict) -> None:
+        self.slabs[row % self.shards].restore_row(row // self.shards,
+                                                  snap)

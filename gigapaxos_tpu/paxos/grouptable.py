@@ -12,6 +12,7 @@ i32 row.
 
 from __future__ import annotations
 
+import threading
 from dataclasses import dataclass
 from typing import Dict, Iterator, Optional, Tuple
 
@@ -36,8 +37,14 @@ class GroupMeta:
 class GroupTable:
     """name/gkey -> (row, members, version).  O(1) create/delete/lookup."""
 
-    def __init__(self, capacity: int):
+    def __init__(self, capacity: int, shards: int = 1):
         self.capacity = capacity
+        # engine-lane sharding (PC.ENGINE_SHARDS): a group's device row
+        # must land in the slab of its shard (= gkey % shards), so rows
+        # are allocated from per-shard free lists holding exactly the
+        # rows with row % shards == shard.  shards=1 is the single list
+        # of old, byte-for-byte.
+        self.shards = max(1, int(shards))
         self._by_key: Dict[int, GroupMeta] = {}
         # flat row->meta list (8B/slot) instead of a dict (~100B/entry)
         self._by_row: list = [None] * capacity
@@ -49,12 +56,25 @@ class GroupTable:
         # native u64->i32 row index (C++ open addressing when available):
         # rows_for_keys answers a whole packet batch in one call
         self._rows = KeyRowMap(min(capacity, 1 << 16))
-        # LIFO free list: recently freed rows are reused first, keeping the
-        # hot row set dense/cache-friendly
-        self._free = list(range(capacity - 1, -1, -1))
+        # serializes create/delete across engine lanes: the four
+        # structures they touch (_by_key, _by_row, per-shard free
+        # lists, _msets incl. its rebuild) must move together — churn
+        # is the cold path, so one uncontended lock per call.  Batched
+        # lookups don't take it (KeyRowMap locks its own native calls).
+        self._mut = threading.Lock()
+        # LIFO free lists (one per shard): recently freed rows are
+        # reused first, keeping the hot row set dense/cache-friendly
+        self._free = [
+            [r for r in range(capacity - 1, -1, -1)
+             if r % self.shards == k]
+            for k in range(self.shards)]
 
     def __len__(self) -> int:
         return len(self._by_key)
+
+    def shard_of(self, gkey: int) -> int:
+        """Engine lane owning this group (= gkey % shards)."""
+        return gkey % self.shards
 
     def create(self, name: str, members: Tuple[int, ...], version: int = 0
                ) -> GroupMeta:
@@ -66,40 +86,49 @@ class GroupTable:
                 raise ValueError(
                     f"group_key collision: {name!r} vs {existing.name!r}")
             raise KeyError(f"group exists: {name}")
-        if not self._free:
-            raise MemoryError("group capacity exhausted")
-        row = self._free.pop()
-        mt = tuple(members)
-        if len(self._msets) > self._msets_rebuild_at:
-            # bound the intern table: rotating memberships could
-            # otherwise accumulate dead sets forever.  Rebuilding from
-            # live groups is O(n), so the threshold doubles whenever a
-            # rebuild fails to shrink below it — with >4K *live* distinct
-            # sets a fixed bound would rebuild on every create, an
-            # O(live-groups) dict build per create.
-            self._msets = {m.members: m.members
-                           for m in self._by_key.values()}
-            self._msets_rebuild_at = max(4096, 2 * len(self._msets))
-        mt = self._msets.setdefault(mt, mt)
-        meta = GroupMeta(name, gkey, row, mt, version)
-        self._by_key[gkey] = meta
-        self._by_row[row] = meta
-        self._rows.put(gkey, row)
+        with self._mut:
+            free = self._free[gkey % self.shards]
+            if not free:
+                raise MemoryError(
+                    "group capacity exhausted"
+                    + (f" (shard {gkey % self.shards})"
+                       if self.shards > 1 else ""))
+            row = free.pop()
+            mt = tuple(members)
+            if len(self._msets) > self._msets_rebuild_at:
+                # bound the intern table: rotating memberships could
+                # otherwise accumulate dead sets forever.  Rebuilding from
+                # live groups is O(n), so the threshold doubles whenever a
+                # rebuild fails to shrink below it — with >4K *live*
+                # distinct sets a fixed bound would rebuild on every
+                # create, an O(live-groups) dict build per create.
+                self._msets = {m.members: m.members
+                               for m in self._by_key.values()}
+                self._msets_rebuild_at = max(4096, 2 * len(self._msets))
+            mt = self._msets.setdefault(mt, mt)
+            meta = GroupMeta(name, gkey, row, mt, version)
+            self._by_key[gkey] = meta
+            self._by_row[row] = meta
+            self._rows.put(gkey, row)
         return meta
 
     def delete(self, gkey: int) -> Optional[GroupMeta]:
-        meta = self._by_key.pop(gkey, None)
-        if meta is None:
-            return None
-        self._by_row[meta.row] = None
-        self._free.append(meta.row)
-        self._rows.delete(gkey)
+        with self._mut:
+            meta = self._by_key.pop(gkey, None)
+            if meta is None:
+                return None
+            self._by_row[meta.row] = None
+            self._free[meta.row % self.shards].append(meta.row)
+            self._rows.delete(gkey)
         return meta
 
     def rows_for_keys(self, gkeys: np.ndarray) -> np.ndarray:
         """Batched gkey -> row lookup; -1 where unknown.  One native call
         for a whole packet batch (the hot-path replacement for a Python
-        dict hit per item)."""
+        dict hit per item).  No table lock here: KeyRowMap serializes
+        its own native calls internally, which already guards the
+        grow-vs-scan race — taking ``_mut`` too would convoy every
+        lane's per-batch lookup on one process-wide lock."""
         return self._rows.get_batch(gkeys)
 
     def by_key(self, gkey: int) -> Optional[GroupMeta]:

@@ -9,13 +9,19 @@ values; a file-streaming path can be added behind the same SPI).
 
 Design:
 
-- **WAL**: one append-only file per node for the hot records (accepts,
-  decisions).  A dedicated writer thread drains a queue, writes a batch,
-  fsyncs ONCE, then resolves the batch's futures — group commit.  The
-  durability ordering contract (SURVEY §7.3.2: log the accept BEFORE
-  sending the accept-reply) is expressed by awaiting the returned future
-  before the reply batch is sent — one fsync barrier per kernel batch,
-  never per packet.
+- **WAL**: append-only *segments* ``wal-<k>.log``, one per engine lane
+  (PC.ENGINE_SHARDS; a single-lane node has exactly ``wal-0.log``).  A
+  group's records live in exactly one segment (its shard's), so
+  per-group record order is preserved across the split and recovery
+  simply replays every segment.  Each segment has its own file handle,
+  lock, and group commit — lanes fsync concurrently (``os.fsync``
+  releases the GIL).  A dedicated writer thread drains a queue, writes
+  a batch, fsyncs ONCE per touched segment, then resolves the batch's
+  futures — group commit.  The durability ordering contract (SURVEY
+  §7.3.2: log the accept BEFORE sending the accept-reply) is expressed
+  by awaiting the returned future before the reply batch is sent — one
+  fsync barrier per kernel batch, never per packet.  Migration: a
+  legacy single ``wal.log`` is adopted as segment 0 on first boot.
 - **sqlite3** (stdlib; the Derby analog) for cold structured state:
   checkpoints(gkey -> name, version, members, slot, app-state blob),
   pause(gkey -> hot-state blob), groups (birth records).
@@ -26,6 +32,7 @@ Design:
 
 from __future__ import annotations
 
+import contextlib
 import json
 import os
 import queue
@@ -72,22 +79,44 @@ class PaxosLogger:
     """WAL + checkpoint store for one node."""
 
     def __init__(self, dirpath: str, sync: bool = True,
-                 compact_threshold_bytes: int = 256 * 1024 * 1024):
+                 compact_threshold_bytes: int = 256 * 1024 * 1024,
+                 segments: int = 1):
         os.makedirs(dirpath, exist_ok=True)
         self.dir = dirpath
         self.sync = sync
         self.compact_threshold = compact_threshold_bytes
-        self._wal_path = os.path.join(dirpath, "wal.log")
-        self._wal = open(self._wal_path, "ab")
-        # compaction runs on the writer thread (it rewrites the whole
-        # file); the hot path only ever *requests* it when the inline
+        self.segments = max(1, int(segments))
+        # migration from the pre-segmented layout: the old single
+        # wal.log becomes segment 0 on first boot (rename, no rewrite)
+        legacy = os.path.join(dirpath, "wal.log")
+        if os.path.exists(legacy):
+            if not os.path.exists(self._seg_path(0)):
+                os.replace(legacy, self._seg_path(0))
+            else:
+                log.warning("both wal.log and wal-0.log exist in %s; "
+                            "reading the legacy file as an extra "
+                            "segment-0 prefix", dirpath)
+        self._wals = [open(self._seg_path(k), "ab")
+                      for k in range(self.segments)]
+        # segments left over from a larger ENGINE_SHARDS setting (and a
+        # legacy wal.log kept because wal-0.log already existed, index
+        # -1): still replayed by read_wal, never written again;
+        # compaction GCs them below the checkpoints and deletes
+        # fully-drained files so neither taxes recovery forever
+        self._stale_segs = [p for k, p in self._disk_segments()
+                            if k >= self.segments or k < 0]
+        # compaction runs on the writer thread (it rewrites a whole
+        # segment); the hot path only ever *requests* it when the inline
         # write crosses the threshold
-        self._compact_pending = False
-        # serializes WAL file writes (writer thread) vs compaction's
-        # snapshot+replace+handle-swap (caller thread): without it, entries
-        # fsync-acked between compact's snapshot and its replace would be
-        # silently lost
-        self._wal_lock = threading.Lock()
+        self._compact_pending = [False] * self.segments
+        # per-segment lock: serializes that segment's file writes
+        # (writer thread, inline lane writes) vs compaction's
+        # snapshot+replace+handle-swap — without it, entries fsync-acked
+        # between compact's snapshot and its replace would be silently
+        # lost.  Locks are per segment so lanes never convoy on each
+        # other's fsync.
+        self._wal_locks = [threading.Lock()
+                           for _ in range(self.segments)]
         self._q: "queue.Queue" = queue.Queue()
         self._closed = False
         self._writer = threading.Thread(target=self._writer_loop,
@@ -113,7 +142,10 @@ class PaxosLogger:
 
     # -- WAL ---------------------------------------------------------------
 
-    def log_batch(self, entries: List[LogEntry]) -> Future:
+    def _seg_path(self, seg: int) -> str:
+        return os.path.join(self.dir, f"wal-{seg}.log")
+
+    def log_batch(self, entries: List[LogEntry], seg: int = 0) -> Future:
         """Queue entries; the future resolves AFTER they are fsync-durable.
         (ref: AbstractPaxosLogger.logBatch + group commit in
         SQLPaxosLogger)"""
@@ -125,10 +157,10 @@ class PaxosLogger:
         if not entries:
             fut.set_result(0)
             return fut
-        self._q.put((entries, fut))
+        self._q.put((entries, fut, seg))
         return fut
 
-    def log_raw(self, buf: bytes) -> Future:
+    def log_raw(self, buf: bytes, seg: int = 0) -> Future:
         """Queue a PRE-ENCODED record buffer (``native.encode_wal`` — the
         hot path's one-C-call replacement for a struct.pack per entry).
         Future resolves after fsync, same contract as :meth:`log_batch`."""
@@ -139,20 +171,22 @@ class PaxosLogger:
         if not buf:
             fut.set_result(0)
             return fut
-        self._q.put((buf, fut))
+        self._q.put((buf, fut, seg))
         return fut
 
     def log_raw_inline(self, buf: bytes, fsync: Optional[bool] = None,
-                       n_entries: int = 1) -> None:
+                       n_entries: int = 1, seg: int = 0) -> None:
         """Write + (fsync) a pre-encoded buffer ON THE CALLING THREAD.
 
-        All hot-path logging comes from the node's single worker thread,
-        so the writer-thread hand-off buys no extra group commit — it
-        only adds two GIL convoy hops (queue put -> writer wake -> future
-        wake) per batch, which measured ~2-5ms each on a saturated
-        1-core host.  Group commit across packets already happened when
-        the worker built the batch.  The queue path remains for callers
-        that want async durability (checkpoint writers, tests)."""
+        All hot-path logging comes from one engine lane's worker thread
+        (``seg`` = that lane's WAL segment), so the writer-thread
+        hand-off buys no extra group commit — it only adds two GIL
+        convoy hops (queue put -> writer wake -> future wake) per batch,
+        which measured ~2-5ms each on a saturated 1-core host.  Group
+        commit across packets already happened when the worker built the
+        batch; across lanes, each segment group-commits independently.
+        The queue path remains for callers that want async durability
+        (checkpoint writers, tests)."""
         if self._closed:
             raise RuntimeError("logger closed")
         import time
@@ -160,22 +194,28 @@ class PaxosLogger:
         # hot-path WAL logging runs on the worker's engine stage, so
         # this span carries that batch's wave id — the "WAL fsync"
         # slice of a traced request's decomposition
-        sp = RequestInstrumenter.span_begin("wal", entries=n_entries)
-        with self._wal_lock:
-            self._wal.write(buf)
-            self._wal.flush()
+        sp = RequestInstrumenter.span_begin("wal", entries=n_entries,
+                                            seg=seg)
+        wal = self._wals[seg]
+        with self._wal_locks[seg]:
+            wal.write(buf)
+            wal.flush()
             if self.sync if fsync is None else fsync:
-                os.fsync(self._wal.fileno())
-            over = self._wal.tell() >= self.compact_threshold
+                os.fsync(wal.fileno())
+            over = wal.tell() >= self.compact_threshold
         RequestInstrumenter.span_end(sp)
         DelayProfiler.update_delay("wal.fsync", t0)
+        if self.segments > 1:
+            # per-segment tail next to the node-wide one: lane skew
+            # (one hot shard fsyncing 10x the others) must be visible
+            DelayProfiler.update_delay(f"wal.fsync@{seg}", t0)
         DelayProfiler.update_rate("wal.entries", n_entries)
-        if over and not self._compact_pending:
+        if over and not self._compact_pending[seg]:
             # hand the rewrite to the writer thread — the worker must
-            # not stall for a whole-file rewrite (ref: SQLPaxosLogger
+            # not stall for a whole-segment rewrite (ref: SQLPaxosLogger
             # log GC below the checkpointed slot, done off-path)
-            self._compact_pending = True
-            self._q.put(("__compact__", None))
+            self._compact_pending[seg] = True
+            self._q.put(("__compact__", None, seg))
 
     def _writer_loop(self) -> None:
         while True:
@@ -195,53 +235,87 @@ class PaxosLogger:
                 pass
             import time
             t0 = time.monotonic()
-            bufs = []
-            compact_req = False
-            for entries, _ in batch:
+            bufs: dict = {}  # seg -> [chunks]
+            compact_req: List[int] = []
+            for entries, _, seg in batch:
                 if entries == "__compact__":
-                    compact_req = True
+                    compact_req.append(seg)
                     continue
+                chunks = bufs.setdefault(seg, [])
                 if isinstance(entries, (bytes, bytearray)):
-                    bufs.append(entries)  # pre-encoded (log_raw)
+                    chunks.append(entries)  # pre-encoded (log_raw)
                     continue
                 for e in entries:
-                    bufs.append(_REC.pack(e.rtype, e.gkey, e.slot, e.bal,
-                                          e.req_id, len(e.payload)))
+                    chunks.append(_REC.pack(e.rtype, e.gkey, e.slot,
+                                            e.bal, e.req_id,
+                                            len(e.payload)))
                     if e.payload:
-                        bufs.append(e.payload)
+                        chunks.append(e.payload)
             try:
-                with self._wal_lock:
-                    self._wal.write(b"".join(bufs))
-                    self._wal.flush()
-                    if self.sync:
-                        os.fsync(self._wal.fileno())
-                for _, fut in batch:
+                for seg, chunks in bufs.items():
+                    wal = self._wals[seg]
+                    with self._wal_locks[seg]:
+                        wal.write(b"".join(chunks))
+                        wal.flush()
+                        if self.sync:
+                            os.fsync(wal.fileno())
+                for _, fut, _seg in batch:
                     if fut is not None:
                         fut.set_result(len(batch))
             except Exception as exc:  # pragma: no cover
-                for _, fut in batch:
+                for _, fut, _seg in batch:
                     if fut is not None:
                         fut.set_exception(exc)
             DelayProfiler.update_delay("wal.fsync", t0)
             DelayProfiler.update_rate(
                 "wal.entries",
                 sum(1 if isinstance(e, (bytes, bytearray)) else len(e)
-                    for e, _ in batch if e != "__compact__"))
-            if compact_req:
+                    for e, _, _ in batch if e != "__compact__"))
+            for seg in compact_req:
                 try:
-                    self.compact_if_needed()
+                    self.compact_if_needed(seg)
                 except Exception:  # pragma: no cover
-                    log.exception("WAL compaction failed")
+                    log.exception("WAL segment %d compaction failed", seg)
                 finally:
-                    self._compact_pending = False
+                    self._compact_pending[seg] = False
+
+    def _disk_segments(self) -> List[Tuple[int, str]]:
+        """(index, path) of every WAL segment present on disk, sorted —
+        recovery must read them ALL, including segments left over from a
+        larger ENGINE_SHARDS setting (a group's records never span
+        segments, so replay order across segments doesn't matter)."""
+        out = []
+        for fn in os.listdir(self.dir):
+            if fn.startswith("wal-") and fn.endswith(".log") \
+                    and not fn.endswith(".tmp"):
+                try:
+                    out.append((int(fn[4:-4]), os.path.join(self.dir,
+                                                            fn)))
+                except ValueError:
+                    continue
+        legacy = os.path.join(self.dir, "wal.log")
+        if os.path.exists(legacy):  # both-files edge (see __init__)
+            out.append((-1, legacy))
+        return sorted(out)
 
     def read_wal(self) -> List[LogEntry]:
-        """Scan all WAL records (recovery roll-forward)."""
-        with self._wal_lock:
-            self._wal.flush()
-            with open(self._wal_path, "rb") as f:
-                data = f.read()
-        return self._parse(data)
+        """Scan all WAL records across every segment (recovery
+        roll-forward).  Per-group order is intact: a group writes to
+        exactly one segment."""
+        out: List[LogEntry] = []
+        for seg, path in self._disk_segments():
+            lock = self._wal_locks[seg] \
+                if 0 <= seg < self.segments else contextlib.nullcontext()
+            with lock:
+                if 0 <= seg < self.segments:
+                    self._wals[seg].flush()
+                try:
+                    with open(path, "rb") as f:
+                        data = f.read()
+                except FileNotFoundError:
+                    continue  # stale segment GC'd between list and open
+            out.extend(self._parse(data))
+        return out
 
     @staticmethod
     def _parse(data: bytes) -> List[LogEntry]:
@@ -259,34 +333,83 @@ class PaxosLogger:
                                 bytes(payload)))
         return out
 
-    def compact_if_needed(self) -> bool:
-        """Rewrite the WAL keeping only entries above each group's
-        checkpointed slot (ref: SQLPaxosLogger log GC below checkpoint)."""
-        if self._wal.tell() < self.compact_threshold:
-            return False
-        self.compact()
-        return True
+    def compact_if_needed(self, seg: Optional[int] = None) -> bool:
+        """Rewrite oversized segment(s) keeping only entries above each
+        group's checkpointed slot (ref: SQLPaxosLogger log GC below
+        checkpoint).  ``seg=None`` checks every segment."""
+        segs = range(self.segments) if seg is None else (seg,)
+        did = False
+        for k in segs:
+            if self._wals[k].tell() >= self.compact_threshold:
+                self.compact_segment(k)
+                did = True
+        if did and self._stale_segs:
+            self._compact_stale()
+        return did
 
     def compact(self) -> None:
+        """Compact every segment (tests/maintenance; the runtime path
+        compacts per segment as each crosses the threshold)."""
+        for k in range(self.segments):
+            self.compact_segment(k)
+        if self._stale_segs:
+            self._compact_stale()
+
+    def _compact_stale(self) -> None:
+        """GC leftover segments from a larger ENGINE_SHARDS.  They are
+        read-only at runtime (no lane writes them, so no lock), shrink
+        as their groups checkpoint past the logged slots, and a fully
+        drained file is deleted outright — bounding the disk and
+        recovery-scan cost of lowering the shard count."""
         cps = {c.gkey: c.slot for c in self.all_checkpoints()}
-        with self._wal_lock:
-            self._wal.flush()
-            with open(self._wal_path, "rb") as f:
+        for path in list(self._stale_segs):
+            try:
+                with open(path, "rb") as f:
+                    data = f.read()
+            except FileNotFoundError:
+                self._stale_segs.remove(path)
+                continue
+            entries = self._parse(data)
+            live = [e for e in entries
+                    if e.slot > cps.get(e.gkey, -1)]
+            if not live:
+                os.remove(path)
+                self._stale_segs.remove(path)
+                continue
+            if len(live) == len(entries):
+                continue  # nothing to drop; skip the rewrite
+            self._rewrite(path, live)
+
+    @staticmethod
+    def _rewrite(path: str, entries: List[LogEntry]) -> None:
+        """Atomically replace a WAL file with exactly ``entries``
+        (tmp-file + fsync + rename) — the one copy of the record
+        byte format shared by live and stale compaction."""
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            for e in entries:
+                f.write(_REC.pack(e.rtype, e.gkey, e.slot, e.bal,
+                                  e.req_id, len(e.payload)))
+                if e.payload:
+                    f.write(e.payload)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+
+    def compact_segment(self, seg: int) -> None:
+        """Rewrite ONE segment; sibling segments are untouched (their
+        locks are never taken, their bytes never read)."""
+        cps = {c.gkey: c.slot for c in self.all_checkpoints()}
+        path = self._seg_path(seg)
+        with self._wal_locks[seg]:
+            self._wals[seg].flush()
+            with open(path, "rb") as f:
                 data = f.read()
             live = [e for e in self._parse(data)
                     if e.slot > cps.get(e.gkey, -1)]
-            tmp = self._wal_path + ".tmp"
-            with open(tmp, "wb") as f:
-                for e in live:
-                    f.write(_REC.pack(e.rtype, e.gkey, e.slot, e.bal,
-                                      e.req_id, len(e.payload)))
-                    if e.payload:
-                        f.write(e.payload)
-                f.flush()
-                os.fsync(f.fileno())
-            old = self._wal
-            os.replace(tmp, self._wal_path)
-            self._wal = open(self._wal_path, "ab")
+            old = self._wals[seg]
+            self._rewrite(path, live)
+            self._wals[seg] = open(path, "ab")
             old.close()
 
     # -- checkpoints -------------------------------------------------------
@@ -465,7 +588,8 @@ class PaxosLogger:
                     item[1].set_exception(RuntimeError("logger closed"))
         except queue.Empty:
             pass
-        self._wal.close()
+        for wal in self._wals:
+            wal.close()
         with self._db_lock:
             self._db.close()
 

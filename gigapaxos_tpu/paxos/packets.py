@@ -750,3 +750,79 @@ def decode(frame: bytes):
     ptype, sender, n = _HDR.unpack_from(frame, 0)
     cls = _DECODERS[PacketType(ptype)]
     return cls.decode(sender, n, memoryview(frame)[_HDR.size:])
+
+
+# --------------------------------------------------------------------------
+# engine-lane shard split (PC.ENGINE_SHARDS)
+# --------------------------------------------------------------------------
+
+
+def _take(obj_payloads: List[bytes], idx: np.ndarray) -> List[bytes]:
+    if not obj_payloads:
+        return []
+    return [obj_payloads[i] for i in idx.tolist()]
+
+
+def shard_split(obj, shards: int) -> Dict[int, object]:
+    """Split a batched SoA packet into per-shard sub-packets by
+    ``gkey % shards`` — the vectorized decode-split stage of the
+    row-sharded engine lanes.  Lane-pure packets (the common steady
+    state: a coordinator's AcceptBatch serves many groups, but peers
+    batch per destination, mixing shards) return ``{shard: obj}``
+    without copying.  Non-batch packets are the caller's problem
+    (single ``gkey`` routes by modulo directly)."""
+    gkeys = np.asarray(obj.gkey)
+    if not len(gkeys):
+        return {0: obj}
+    sh = (gkeys % np.uint64(shards)).astype(np.int64)
+    lo = int(sh.min())
+    if lo == int(sh.max()):
+        return {lo: obj}
+    t = type(obj)
+    out: Dict[int, object] = {}
+    for k in np.unique(sh).tolist():
+        idx = np.flatnonzero(sh == k)
+        if t is AcceptBatch:
+            out[k] = AcceptBatch(
+                obj.sender, gkeys[idx], obj.slot[idx], obj.bal[idx],
+                obj.req_lo[idx], obj.req_hi[idx],
+                _take(obj.payloads, idx))
+        elif t is AcceptReplyBatch:
+            out[k] = AcceptReplyBatch(
+                obj.sender, gkeys[idx], obj.slot[idx], obj.bal[idx],
+                obj.acked[idx])
+        elif t is CommitBatch:
+            out[k] = CommitBatch(
+                obj.sender, gkeys[idx], obj.slot[idx], obj.bal[idx],
+                obj.req_lo[idx], obj.req_hi[idx])
+        elif t is PrepareBatch:
+            out[k] = PrepareBatch(obj.sender, gkeys[idx], obj.bal[idx])
+        elif t is PrepareReplyBatch:
+            # ragged window columns: gather each kept lane's slice of
+            # the flattened arrays (vectorized via repeat/arange)
+            counts = np.asarray(obj.counts)
+            offs = np.concatenate(
+                [[0], np.cumsum(counts)]).astype(np.int64)
+            kc = counts[idx]
+            total = int(kc.sum())
+            if total:
+                starts = offs[idx]
+                # flat indices of the kept lanes' window entries
+                wsel = np.repeat(starts, kc) + (
+                    np.arange(total)
+                    - np.repeat(np.concatenate(
+                        [[0], np.cumsum(kc)[:-1]]).astype(np.int64),
+                        kc))
+            else:
+                wsel = np.zeros(0, np.int64)
+            out[k] = PrepareReplyBatch(
+                obj.sender, gkeys[idx], obj.bal[idx], obj.acked[idx],
+                obj.cursor[idx], kc,
+                np.asarray(obj.slots)[wsel],
+                np.asarray(obj.wbals)[wsel],
+                np.asarray(obj.req_lo)[wsel],
+                np.asarray(obj.req_hi)[wsel],
+                _take(obj.payloads, wsel))
+        else:
+            raise TypeError(f"shard_split: unsupported {t.__name__}")
+    return out

@@ -33,6 +33,17 @@ class PC(ConfigKey):
     # backend: "columnar" (JAX/TPU), "native" (C++ per-instance host
     # engine), or "scalar" (interpreted per-instance oracle)
     BACKEND = "columnar"
+    # row-sharded engine lanes (columnar backend only): partition the
+    # group space into this many independent lanes (shard = group_key %
+    # S).  Each lane owns a ColumnarBackend slab of CAPACITY/S rows, a
+    # 3-stage worker (decode-split | engine+WAL | emit), and its own
+    # WAL segment wal-<k>.log with per-lane group commit — engine
+    # waves, fsyncs, and emit encodes for different shards run
+    # concurrently (XLA dispatch and os.fsync release the GIL, so this
+    # is real multi-core parallelism).  1 = today's single-lane
+    # pipeline, byte-for-byte.  Raise toward the host's core count
+    # once a single lane saturates (see README "Scaling out a node").
+    ENGINE_SHARDS = 1
     # shard the columnar [G, W] state over the group axis of a device
     # mesh: "auto" = across all local devices when >1 and capacity
     # divides evenly (SURVEY §2.7 TP row — the runtime path, not just

@@ -95,6 +95,13 @@ def main(argv=None) -> int:
                         "active.* entries are used; groups are created "
                         "by clients (CreateGroup) or the GROUPS= "
                         "properties key (members = all actives)")
+    p.add_argument("--engine-shards", type=int, default=None,
+                   help="row-sharded engine lanes (columnar backend): "
+                        "each lane gets CAPACITY/S device rows, its own "
+                        "worker, and WAL segment wal-<k>.log; raise "
+                        "toward the host's core count once one lane "
+                        "saturates (or ENGINE_SHARDS= in the properties "
+                        "file; default 1)")
     p.add_argument("--stats-port", type=int, default=None,
                    help="per-node HTTP stats listener port (GET /metrics"
                         " Prometheus text, /stats JSON snapshot); 0 = "
@@ -131,6 +138,11 @@ def main(argv=None) -> int:
     # reads them from Config at start()
     from gigapaxos_tpu.paxos.paxosconfig import PC
     from gigapaxos_tpu.utils.config import Config
+    shards = args.engine_shards if args.engine_shards is not None \
+        else (int(extras["ENGINE_SHARDS"])
+              if "ENGINE_SHARDS" in extras else None)
+    if shards is not None:
+        Config.set(PC.ENGINE_SHARDS, shards)
     stats_port = args.stats_port if args.stats_port is not None \
         else (int(extras["STATS_PORT"]) if "STATS_PORT" in extras
               else None)

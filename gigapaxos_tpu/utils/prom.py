@@ -16,6 +16,18 @@ _QUANTILES = (("0.5", "p50_s"), ("0.9", "p90_s"), ("0.99", "p99_s"),
               ("0.999", "p999_s"))
 
 
+def _tag_labels(tag: str, key: str) -> Dict[str, str]:
+    """Profiler tag -> label set.  Sharded engine lanes suffix their hot
+    tags with ``@<shard>`` (``eng.submit@2``, ``wal.fsync@0``,
+    ``w.process@1``); the suffix becomes a ``shard`` label so per-lane
+    series aggregate and filter like any other Prometheus dimension."""
+    if "@" in tag:
+        base, _, sh = tag.rpartition("@")
+        if sh.isdigit():
+            return {key: base, "shard": sh}
+    return {key: tag}
+
+
 def _esc(v: str) -> str:
     return str(v).replace("\\", r"\\").replace('"', r'\"') \
         .replace("\n", r"\n")
@@ -67,11 +79,11 @@ class _Writer:
         for tag, h in sorted(hists.items()):
             if not h.get("count"):
                 continue
+            labels = _tag_labels(tag, label_key)
             for q, key in _QUANTILES:
-                q_rows.append(({label_key: tag, "quantile": q},
-                               h.get(key)))
-            sums.append(({label_key: tag}, h.get("sum_s")))
-            counts.append(({label_key: tag}, h.get("count")))
+                q_rows.append((dict(labels, quantile=q), h.get(key)))
+            sums.append((labels, h.get("sum_s")))
+            counts.append((labels, h.get("count")))
         if not counts:
             return
         self.lines.append(f"# HELP {name} {help_}")
@@ -117,6 +129,10 @@ def render_prometheus(m: dict, prefix: str = "gp") -> str:
     if "groups" in c:
         w.family(f"{p}_groups", "gauge", "resident paxos groups",
                  [(None, c["groups"])])
+    if "engine_shards" in c:
+        w.family(f"{p}_engine_shards", "gauge",
+                 "row-sharded engine lanes (PC.ENGINE_SHARDS)",
+                 [(None, c["engine_shards"])])
     if "backlog_est" in c:
         w.family(f"{p}_backlog_frames", "gauge",
                  "estimated inbound backlog in frames",
@@ -157,29 +173,29 @@ def render_prometheus(m: dict, prefix: str = "gp") -> str:
     if totals:
         w.family(f"{p}_stage_wall_seconds_total", "counter",
                  "wall seconds accumulated per pipeline stage",
-                 [({"stage": t}, v.get("wall_s"))
+                 [(_tag_labels(t, "stage"), v.get("wall_s"))
                   for t, v in sorted(totals.items())])
         w.family(f"{p}_stage_cpu_seconds_total", "counter",
                  "CPU seconds per stage (PC.PROFILE_CPU)",
-                 [({"stage": t}, v.get("cpu_s"))
+                 [(_tag_labels(t, "stage"), v.get("cpu_s"))
                   for t, v in sorted(totals.items())])
         w.family(f"{p}_stage_calls_total", "counter",
                  "calls per stage",
-                 [({"stage": t}, v.get("calls"))
+                 [(_tag_labels(t, "stage"), v.get("calls"))
                   for t, v in sorted(totals.items())])
         w.family(f"{p}_stage_items_total", "counter",
                  "items per stage",
-                 [({"stage": t}, v.get("items"))
+                 [(_tag_labels(t, "stage"), v.get("items"))
                   for t, v in sorted(totals.items())])
     rates = prof.get("rates", {})
     if rates:
         w.family(f"{p}_rate_per_second", "gauge",
                  "windowed event rate per tag",
-                 [({"tag": t}, v.get("per_sec"))
+                 [(_tag_labels(t, "tag"), v.get("per_sec"))
                   for t, v in sorted(rates.items())])
         w.family(f"{p}_events_total", "counter",
                  "cumulative event count per rate tag",
-                 [({"tag": t}, v.get("count"))
+                 [(_tag_labels(t, "tag"), v.get("count"))
                   for t, v in sorted(rates.items())])
     hists = prof.get("histograms", {})
     if hists:

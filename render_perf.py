@@ -149,6 +149,24 @@ def render() -> str:
             f"{tail('wal.fsync')} — live on any node via `GET /metrics`"
             " (see README Observability) |")
 
+        # per-shard lane balance (ENGINE_SHARDS > 1): the w.process@<k>
+        # totals show at a glance whether one lane is carrying the node
+        totals = snap.get("totals", {})
+        lanes = sorted((int(t.rpartition("@")[2]), v)
+                       for t, v in totals.items()
+                       if t.startswith("w.process@"))
+        if lanes:
+            walls = [v.get("wall_s", 0.0) for _k, v in lanes]
+            skew = (max(walls) / max(min(walls), 1e-9)) \
+                if min(walls) > 0 else float("inf")
+            cells = " ".join(f"s{k}={v.get('wall_s', 0.0):.2f}s/"
+                             f"{v.get('items', 0)}i"
+                             for k, v in lanes)
+            out.append(
+                f"| Engine-lane balance ({len(lanes)} shards, "
+                "`w.process@<k>` wall s / items) | "
+                f"{cells} — max/min skew {skew:.2f}x |")
+
     r = row("config2_columnar_100k_groups_host_xla_knee")
     if r:
         i = r["info"]

@@ -10,6 +10,8 @@ from gigapaxos_tpu.paxos.client import PaxosClient
 from gigapaxos_tpu.paxos.paxosconfig import PC
 from gigapaxos_tpu.utils.config import Config
 from gigapaxos_tpu.utils.instrument import RequestInstrumenter
+
+pytestmark = pytest.mark.smoke  # <60s fast-signal subset
 from tests.conftest import tscale
 from tests.test_e2e import make_cluster, shutdown
 

@@ -26,6 +26,13 @@ def test_pipelined_worker_e2e(tmp_path, backend):
     interplay (the fused calls run on the process thread while the
     intake thread decodes)."""
     Config.set(PC.PIPELINE_WORKER, True)
+    # correctness test, not a capacity test: a mid-load jit compile (or
+    # neighboring-suite CPU noise) stalls the engine long enough for
+    # the backlog estimate to trip the congestion shed, and ONE shed
+    # status-1 reply fails the ok==n assert (observed 149/150 under a
+    # full-suite run).  Shedding behavior has its own test
+    # (test_shedding.py); here it must not fire.
+    Config.set(PC.INTAKE_BACKLOG_LIMIT, 0)
     emu = PaxosEmulation(str(tmp_path), n_nodes=3, n_groups=64,
                          backend=backend)
     try:
@@ -38,17 +45,23 @@ def test_pipelined_worker_e2e(tmp_path, backend):
         # compiles of fresh (op, bucket) specializations land in-window
         stats = emu.run_load(n, concurrency=32, timeout=tscale(40))
         assert stats["ok"] == n, stats
-        # three replicas converge on the same execution count.
-        # tscale(25): on a cold .jax_cache the straggler's catch-up
-        # commits queue behind fresh kernel compiles (observed: one
-        # replica 5 executions behind at a tscale(10) cutoff, green at
-        # the wider window)
+        # three replicas converge on the same executed-slot frontier
+        # (summed exec cursors, NOT n_executed: a straggler whose lost
+        # final commits are repaired via the checkpoint catch-up path
+        # advances its cursor without executing, so the n_executed
+        # counters can legitimately never equalize — observed ~1-in-5
+        # on this box as a permanent 2-behind count).  tscale(25): on a
+        # cold .jax_cache the straggler's catch-up commits queue behind
+        # fresh kernel compiles.
+        def frontiers():
+            return {int(nd._cur.sum()) for nd in emu.nodes.values()}
         deadline = time.time() + tscale(25)
         while time.time() < deadline:
-            if len({nd.n_executed for nd in emu.nodes.values()}) == 1:
+            if len(frontiers()) == 1:
                 break
             time.sleep(0.05)
-        assert len({nd.n_executed for nd in emu.nodes.values()}) == 1
+        assert len(frontiers()) == 1, \
+            {i: int(nd._cur.sum()) for i, nd in emu.nodes.items()}
     finally:
         emu.stop()
 

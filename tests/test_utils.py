@@ -2,8 +2,12 @@
 
 import os
 
+import pytest
+
 from gigapaxos_tpu.utils.config import Config, ConfigKey
 from gigapaxos_tpu.utils.profiler import DelayProfiler
+
+pytestmark = pytest.mark.smoke  # <60s fast-signal subset
 
 
 class TC(ConfigKey):
