@@ -1,4 +1,5 @@
-"""Tiny per-node stats listener: GET /metrics | /stats | /healthz.
+"""Tiny per-node stats listener: GET /metrics | /stats | /healthz |
+/groups | /groups/<id> | /traces/<trace_id>.
 
 Every server process becomes scrapeable without the full HTTP gateway:
 a dependency-free asyncio HTTP/1.0-style responder living on the node's
@@ -6,13 +7,19 @@ existing event loop (enabled by ``PC.STATS_PORT``; 0 binds an ephemeral
 port, exposed via :attr:`port`).  ``/metrics`` is Prometheus text
 exposition over the node's ``metrics()`` dict, ``/stats`` the same dict
 as JSON — the machine-readable replacement for scraping the one-line
-``stats()`` render.
+``stats()`` render.  ``/groups`` is the consensus-health introspection
+plane (leader, ballot, churn, exec/WAL lag per group) and
+``/traces/<id>`` exports this node's share of one sampled request's
+trace ring — the per-node source the gateway's ``/cluster/traces/<id>``
+stitches into a cross-node breakdown.
 """
 
 from __future__ import annotations
 
 import asyncio
+import json
 from typing import Callable, Optional, Tuple
+from urllib.parse import unquote
 
 from gigapaxos_tpu.utils.logutil import get_logger
 from gigapaxos_tpu.utils.prom import metrics_response
@@ -20,13 +27,71 @@ from gigapaxos_tpu.utils.prom import metrics_response
 log = get_logger("gp.statshttp")
 
 
+def parse_trace_id(s: str) -> Optional[int]:
+    """Trace ids arrive as decimal or 0x-hex (the format slow-trace
+    logs and ``format()`` print)."""
+    try:
+        return int(s, 0)
+    except ValueError:
+        return None
+
+
+def _json_resp(obj) -> Tuple[str, str, bytes]:
+    return ("200 OK", "application/json",
+            json.dumps(obj, default=str).encode())
+
+
+def observability_routes(path: str, groups_fn: Optional[Callable] = None,
+                         group_fn: Optional[Callable] = None):
+    """Shared GET route bodies for the introspection endpoints (the
+    per-node listener and the HTTP gateway serve identical content):
+
+    - ``/groups[?limit=N]``   -> ``groups_fn(limit)`` summary dict
+    - ``/groups/<name|gkey>`` -> ``group_fn(ident)`` detail (404 None)
+    - ``/traces/<trace_id>``  -> this process's trace export + its
+      local breakdown (the cluster stitch input)
+
+    Returns ``(status, content_type, body)`` or None (no match).
+    """
+    path, _, query = path.partition("?")
+    if path == "/groups" and groups_fn is not None:
+        limit = 256
+        for part in query.split("&"):
+            if part.startswith("limit="):
+                try:
+                    limit = max(1, int(part[len("limit="):]))
+                except ValueError:
+                    pass
+        return _json_resp(groups_fn(limit=limit))
+    if path.startswith("/groups/") and group_fn is not None:
+        info = group_fn(unquote(path[len("/groups/"):]))
+        if info is None:
+            return ("404 Not Found", "application/json",
+                    b'{"err":"no such group"}')
+        return _json_resp(info)
+    if path.startswith("/traces/"):
+        tid = parse_trace_id(path[len("/traces/"):])
+        if tid is None:
+            return ("400 Bad Request", "application/json",
+                    b'{"err":"bad trace id"}')
+        from gigapaxos_tpu.utils.instrument import RequestInstrumenter
+        ex = RequestInstrumenter.export_trace(tid)
+        ex["breakdown"] = RequestInstrumenter.cluster_breakdown(tid, [ex])
+        return _json_resp(ex)
+    return None
+
+
 class StatsListener:
-    """Serves a ``metrics_fn() -> dict`` over loopback HTTP."""
+    """Serves a ``metrics_fn() -> dict`` over loopback HTTP, plus any
+    ``extra_routes(path) -> (status, ctype, body) | None`` hook (the
+    node wires its introspection routes through it)."""
 
     def __init__(self, metrics_fn: Callable[[], dict],
-                 listen: Tuple[str, int] = ("127.0.0.1", 0)):
+                 listen: Tuple[str, int] = ("127.0.0.1", 0),
+                 extra_routes: Optional[Callable] = None):
         self.metrics_fn = metrics_fn
         self.listen = listen
+        self.extra_routes = extra_routes
         self._server: Optional[asyncio.base_events.Server] = None
 
     async def start(self) -> None:
@@ -75,6 +140,10 @@ class StatsListener:
             resp = metrics_response(path, self.metrics_fn)
             if resp is not None:
                 return resp
+            if self.extra_routes is not None:
+                resp = self.extra_routes(path)
+                if resp is not None:
+                    return resp
         except Exception:
             log.exception("stats render failed")
             return ("500 Internal Server Error", "text/plain",

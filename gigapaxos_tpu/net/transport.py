@@ -28,6 +28,8 @@ from __future__ import annotations
 import asyncio
 import ssl as ssl_mod
 import struct
+import threading
+import time
 from collections import deque
 from typing import Awaitable, Callable, Dict, Optional, Tuple
 
@@ -135,6 +137,13 @@ class Transport:
         self.drop_test = 0         # test_drop_rate fault injection
         self.reconnects = 0        # reconnect attempts after 1st connect
         self.connect_failures = 0  # connect attempts that failed
+        # per-peer RTT from the failure-detector ping/pong (the cluster
+        # tracing plane's network-hop baseline): peer -> [ewma_s, count].
+        # note_rtt runs on the node's worker thread while metrics()
+        # scrapes from the event loop — the lock keeps a first-pong
+        # insert from blowing up a concurrent scrape's iteration.
+        self._rtt: Dict[int, list] = {}
+        self._rtt_lock = threading.Lock()
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -454,10 +463,30 @@ class Transport:
                     self._drop(1, "write_error")
                     return
 
+    def note_rtt(self, peer: int, rtt_s: float) -> None:
+        """Record one ping/pong round trip to ``peer`` (called by the
+        node's FailureDetect pong handler).  Feeds the per-peer EWMA in
+        :meth:`metrics` and the node-wide ``net.rtt`` histogram, so
+        /metrics carries link-latency quantiles per node — the
+        network-hop baseline a cross-node trace is read against."""
+        with self._rtt_lock:
+            e = self._rtt.get(peer)
+            if e is None:
+                self._rtt[peer] = [rtt_s, 1]
+            else:
+                e[0] += 0.1 * (rtt_s - e[0])
+                e[1] += 1
+        DelayProfiler.update_delay("net.rtt",
+                                   time.monotonic() - rtt_s)
+
     def metrics(self) -> dict:
         """Structured counters (the machine face; :meth:`stats` is the
         one-line render over this)."""
+        with self._rtt_lock:
+            rtt = {p: {"ewma_s": e[0], "count": e[1]}
+                   for p, e in sorted(self._rtt.items())}
         return {
+            "rtt": rtt,
             "tx_frames": self.sent_frames,
             "tx_bytes": self.sent_bytes,
             "rx_frames": self.rcvd_frames,

@@ -142,6 +142,34 @@ class AcceptorBackend(abc.ABC):
         they can gather many rows in one device round trip."""
         return [self.snapshot_row(int(r)) for r in rows]
 
+    def inspect_rows(self, rows) -> Dict[str, np.ndarray]:
+        """Device-truth consensus cursors for the introspection plane
+        (``GET /groups``): promised ballot, coordinator ballot, next
+        proposal slot, exec cursor — per row, as parallel arrays.
+        Default goes through the (heavier) snapshot path; the columnar
+        backend overrides with one gather + one transfer."""
+        snaps = self.snapshot_rows(np.asarray(rows, np.int64))
+
+        def field(s: dict, key: str, scal_idx: int, default: int) -> int:
+            # the native store packs its per-row scalars into `scal`
+            # ([bal, cbal, exec_cursor, next_slot, ...]); the scalar
+            # oracle snapshot carries named keys
+            if "scal" in s:
+                return int(s["scal"][scal_idx])
+            return int(s.get(key, default))
+
+        return {
+            "bal": np.asarray(
+                [field(s, "bal", 0, -1) for s in snaps], np.int64),
+            "cbal": np.asarray(
+                [field(s, "cbal", 1, -1) for s in snaps], np.int64),
+            "next_slot": np.asarray(
+                [field(s, "next_slot", 3, 0) for s in snaps], np.int64),
+            "exec_cursor": np.asarray(
+                [field(s, "exec_cursor", 2, 0) for s in snaps],
+                np.int64),
+        }
+
     engine_platform = "cpu"  # overridden by device-resident backends
 
     def accept_commit(self, rows_a, slots_a, bals_a, reqs_a,
@@ -1083,6 +1111,22 @@ class ColumnarBackend(AcceptorBackend):
     def cursor_of(self, row: int) -> int:
         return int(self.state.exec_cursor[row])
 
+    def inspect_rows(self, rows) -> Dict[str, np.ndarray]:
+        """ONE stacked gather + ONE device->host transfer for the four
+        scalar consensus planes — the cheap vectorized extraction the
+        ``/groups`` introspection endpoint leans on (snapshot_rows
+        hauls the full [W, 4] window planes; this hauls 4 ints/row)."""
+        rows = np.asarray(rows, np.int32)
+        st = self.state
+        with self._disp():
+            import jax
+            stacked = jax.device_get(jax.numpy.stack(
+                (st.bal[rows], st.cbal[rows], st.next_slot[rows],
+                 st.exec_cursor[rows])))
+        stacked = np.asarray(stacked, np.int64)
+        return {"bal": stacked[0], "cbal": stacked[1],
+                "next_slot": stacked[2], "exec_cursor": stacked[3]}
+
     def snapshot_row(self, row: int) -> dict:
         return self.snapshot_rows([row])[0]
 
@@ -1455,6 +1499,16 @@ class ShardedColumnarBackend(AcceptorBackend):
     def cursor_of(self, row: int) -> int:
         return self.slabs[row % self.shards].cursor_of(
             row // self.shards)
+
+    def inspect_rows(self, rows) -> Dict[str, np.ndarray]:
+        rows = np.asarray(rows)
+        out = {k: np.zeros(len(rows), np.int64)
+               for k in ("bal", "cbal", "next_slot", "exec_cursor")}
+        for k, idx, local in self._split(rows):
+            part = self.slabs[k].inspect_rows(local)
+            for f, arr in part.items():
+                out[f][idx] = arr
+        return out
 
     def snapshot_row(self, row: int) -> dict:
         return self.slabs[row % self.shards].snapshot_row(

@@ -101,6 +101,17 @@ class PaxosClientAsync:
         dedup is server-side) to the next replica."""
         gkey = pkt.group_key(name)
         req_id = self.next_req_id()
+        # mint the trace context at the client (the cluster tracing
+        # plane's entry point): when this process samples the request
+        # — or the caller pre-set FLAG_SAMPLED — stamp the wire bit so
+        # every node honors the verdict without recomputing it.  With
+        # tracing disabled this is one class-attribute check.
+        from gigapaxos_tpu.utils.instrument import RequestInstrumenter
+        if RequestInstrumenter.enabled:
+            ctx = RequestInstrumenter.mint(
+                req_id, bool(flags & pkt.Request.FLAG_SAMPLED))
+            if ctx.sampled:
+                flags |= pkt.Request.FLAG_SAMPLED
         last_exc: Optional[Exception] = None
         deadline = asyncio.get_running_loop().time() + self.timeout
         attempt = 0

@@ -144,3 +144,10 @@ class GroupTable:
 
     def __iter__(self) -> Iterator[GroupMeta]:
         return iter(self._by_key.values())
+
+    def snapshot_metas(self) -> list:
+        """Stable list of live metas (under the mutation lock, so the
+        introspection plane can iterate while lanes create/delete —
+        bare dict iteration raises if a create lands mid-scan)."""
+        with self._mut:
+            return list(self._by_key.values())

@@ -145,6 +145,23 @@ class PaxosLogger:
     def _seg_path(self, seg: int) -> str:
         return os.path.join(self.dir, f"wal-{seg}.log")
 
+    def segment_stats(self) -> List[dict]:
+        """Per-segment WAL lag view for the introspection plane: bytes
+        written since the segment's last compaction rewrite (``tell()``
+        of the append handle — no stat syscall) and whether a
+        compaction is queued.  Growth toward ``compact_threshold``
+        is the 'WAL segment lag' signal ``GET /groups`` reports."""
+        out = []
+        for k, wal in enumerate(self._wals):
+            with self._wal_locks[k]:
+                try:
+                    size = wal.tell()
+                except ValueError:  # closed mid-shutdown
+                    size = -1
+            out.append({"segment": k, "bytes": size,
+                        "compacting": bool(self._compact_pending[k])})
+        return out
+
     def log_batch(self, entries: List[LogEntry], seg: int = 0) -> Future:
         """Queue entries; the future resolves AFTER they are fsync-durable.
         (ref: AbstractPaxosLogger.logBatch + group commit in

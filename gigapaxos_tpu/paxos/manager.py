@@ -64,6 +64,10 @@ FLAG_NOOP = 2
 # only): receivers keep their own copy if they have one; executors treat a
 # still-missing payload as a gap and sync — never fabricate an empty one
 FLAG_MISSING = 4
+# client-forced trace sampling (the wire bit; see packets.Request).
+# The coordinator also stamps it onto hash-sampled requests at propose
+# time, so acceptors honor the verdict even if configured differently.
+FLAG_SAMPLED = pkt.Request.FLAG_SAMPLED
 
 _UNSET = object()  # cache-miss sentinel (None is a valid cached value)
 
@@ -460,6 +464,18 @@ class PaxosNode:
             # (the documented runtime switch) must survive later node
             # constructions; tests reset it via their fixture
             RequestInstrumenter.enabled = True
+        # cluster tracing plane (PC.TRACE_SAMPLE): deterministic
+        # per-request sampling — every node reaches the same verdict
+        # from the req_id alone, so a 3-node trace needs zero
+        # propagated bytes.  Only-enable, like TRACE_REQUESTS.
+        RequestInstrumenter.configure(
+            max_age_s=float(Config.get(PC.TRACE_MAX_AGE_S)),
+            slow_threshold_s=float(Config.get(PC.SLOW_TRACE_S)),
+            slow_k=int(Config.get(PC.SLOW_TRACE_K)))
+        trace_sample = float(Config.get(PC.TRACE_SAMPLE))
+        if trace_sample > 0:
+            RequestInstrumenter.configure(sample_rate=trace_sample)
+            RequestInstrumenter.enabled = True
         # failure detection (ref: gigapaxos/FailureDetection.java)
         self._last_heard: Dict[int, float] = {}
         self.ping_interval = float(Config.get(PC.PING_INTERVAL_S))
@@ -526,6 +542,20 @@ class PaxosNode:
         self.n_park_dropped = 0   # parked proposals dropped at cap
         self.n_redrive_capped = 0  # re-drive ticks that hit the 256 cap
         self.n_installs = 0       # coordinator installs won (failover)
+        # ballot churn (consensus-health introspection; PAPERS
+        # 2006.01885 motivates surfacing leader/ballot churn as a
+        # first-class signal): bumped wherever this node adopts a NEW
+        # ballot for a row — election installs, preemption adoptions,
+        # higher-ballot promises.  Per-row counts feed GET /groups;
+        # the node total feeds gp_ballot_changes_total.
+        self._bal_changes = np.zeros(cap, np.int64)
+        self.n_ballot_changes = 0
+        # trace ids FORCED onto this node via FLAG_SAMPLED while the
+        # deterministic hash said no (client-forced traces): the
+        # vectorized hash prefilters at the dec/com.tx stamp sites
+        # would miss them, so they ride this small in-flight set
+        # (entries leave at execution)
+        self._forced_traces: Set[int] = set()
 
     # ------------------------------------------------------------------
     # per-processing-thread batch state (thread-local properties).
@@ -621,7 +651,8 @@ class PaxosNode:
                 from gigapaxos_tpu.net.statshttp import StatsListener
                 try:
                     self.stats_http = StatsListener(
-                        self.metrics, ("127.0.0.1", sport))
+                        self.metrics, ("127.0.0.1", sport),
+                        extra_routes=self._obs_route)
                     self._loop.run_until_complete(
                         self.stats_http.start())
                 except OSError as exc:
@@ -759,6 +790,7 @@ class PaxosNode:
         self._bal[rows] = bals
         self._cur[rows] = 0
         self._ckpt[rows] = -1
+        self._bal_changes[rows] = 0  # recycled rows start clean
         # idle-from-birth groups must still be pause-eligible
         self._la[rows] = now
         self._member_mat[rows] = -1
@@ -1897,6 +1929,13 @@ class PaxosNode:
         for o in by_type.pop(pkt.FailureDetect, []):
             if not o.is_pong:
                 self._route(o.sender, pkt.FailureDetect(self.id, 1, o.ts_ns))
+            else:
+                # pong carries our own ping's wall stamp: one RTT
+                # sample per peer per ping interval — the per-link
+                # latency baseline a cross-node trace is read against
+                rtt = (time.time_ns() - o.ts_ns) / 1e9
+                if 0.0 <= rtt < 60.0:  # guard clock steps
+                    self.transport.note_rtt(o.sender, rtt)
         for o in by_type.pop(pkt.Response, []):
             # a peer answered a forwarded (deduped) proposal: relay to the
             # client still waiting on us as its entry replica
@@ -2063,6 +2102,16 @@ class PaxosNode:
         layers: epoch-FSM retries, demand reporting)."""
         self._tick_hooks.append(fn)
 
+    def _note_ballot_change(self, rows) -> None:
+        """Count ballot/leadership churn per row + node-wide (called
+        from the cold election/preemption/promise paths only)."""
+        rows = np.atleast_1d(np.asarray(rows, np.int64))
+        if not len(rows):
+            return
+        np.add.at(self._bal_changes, rows, 1)
+        with self._stat_lock:
+            self.n_ballot_changes += len(rows)
+
     def metrics(self, include_profiler: bool = True) -> dict:
         """Structured node metrics: counters + engine overlap split +
         transport counters + the process-global profiler snapshot and
@@ -2090,6 +2139,7 @@ class PaxosNode:
                 "park_dropped": self.n_park_dropped,
                 "shed": self.n_shed,
                 "installs": self.n_installs,
+                "ballot_changes": self.n_ballot_changes,
                 "groups": len(self.table),
                 "backlog_est": self._backlog_est,
                 "engine_shards": self.shards,
@@ -2107,9 +2157,119 @@ class PaxosNode:
             "net": self.transport.metrics(),
         }
         if include_profiler:
+            # consensus-health aggregates (GET /groups has the per-
+            # group detail; these are the per-scrape node rollups).
+            # Gated with the profiler snapshot: the health scan is
+            # O(groups), and the one-line stats() render — which may
+            # run every few seconds against a million-group node —
+            # asks for the cheap counters-only view
+            out["groups_health"] = self._groups_health()
+            out["wal"] = {"segments": self.logger.segment_stats()}
             out["profiler"] = DelayProfiler.snapshot()
             out["spans"] = RequestInstrumenter.span_stats()
+            slow = RequestInstrumenter.slow_traces()
+            if slow:
+                out["slow_traces"] = slow
         return out
+
+    def _groups_health(self) -> dict:
+        """Node-wide consensus-health rollup from the host mirrors
+        (no device round trip — cheap enough for every scrape): exec
+        lag = accepted-but-not-yet-executed slots per group."""
+        rows = np.asarray([m.row for m in self.table.snapshot_metas()],
+                          np.int64)
+        if not len(rows):
+            return {"groups": 0, "exec_lag_max": 0, "exec_lag_sum": 0,
+                    "exec_lag_mean": 0.0, "ballot_changes_max": 0}
+        lag = np.maximum(self._acc_hi[rows] + 1 - self._cur[rows], 0)
+        return {
+            "groups": int(len(rows)),
+            "exec_lag_max": int(lag.max()),
+            "exec_lag_sum": int(lag.sum()),
+            "exec_lag_mean": float(round(lag.mean(), 3)),
+            "ballot_changes_max": int(self._bal_changes[rows].max()),
+        }
+
+    def groups_info(self, limit: int = 256) -> dict:
+        """``GET /groups``: per-group consensus health, worst exec-lag
+        first — leader, ballot, churn count, cursors, WAL segment.
+        Host mirrors are scanned vectorized; device truth (promised /
+        coordinator ballots, next slot, exec cursor) comes from ONE
+        columnar gather over the returned rows only."""
+        metas = self.table.snapshot_metas()
+        if not metas:
+            return {"count": 0, "returned": 0, "truncated": False,
+                    "groups": []}
+        rows = np.asarray([m.row for m in metas], np.int64)
+        lag = np.maximum(self._acc_hi[rows] + 1 - self._cur[rows], 0)
+        sel = np.argsort(-lag, kind="stable")[:max(1, int(limit))]
+        dev = self._inspect_locked(rows[sel])
+        groups = [self._group_dict(metas[i], int(lag[i]), dev, j)
+                  for j, i in enumerate(sel.tolist())]
+        return {"count": len(metas), "returned": len(groups),
+                "truncated": len(groups) < len(metas),
+                "groups": groups}
+
+    def group_info(self, ident) -> Optional[dict]:
+        """``GET /groups/<id>``: one group by name (or decimal/hex
+        group key); None when unknown."""
+        meta = self.table.by_name(str(ident))
+        if meta is None:
+            try:
+                meta = self.table.by_key(int(str(ident), 0))
+            except ValueError:
+                meta = None
+        if meta is None:
+            return None
+        lag = int(max(0, int(self._acc_hi[meta.row]) + 1
+                      - int(self._cur[meta.row])))
+        return self._group_dict(
+            meta, lag, self._inspect_locked(
+                np.asarray([meta.row], np.int64)), 0)
+
+    def _inspect_locked(self, rows: np.ndarray) -> dict:
+        """Device-truth gather for ``rows`` under the owning engine
+        locks (the columnar engine swaps donated buffers per call — an
+        unlocked read can observe a deleted buffer)."""
+        with contextlib.ExitStack() as stack:
+            for lk in self._locks_for(int(r) % self.shards
+                                      for r in rows):
+                stack.enter_context(lk)
+            return self.backend.inspect_rows(rows)
+
+    def _group_dict(self, meta, lag: int, dev: dict, j: int) -> dict:
+        row = meta.row
+        num, coord = unpack_ballot(int(self._bal[row]))
+        shard = self.table.shard_of(meta.gkey)
+        d = {
+            "name": meta.name,
+            "gkey": f"{meta.gkey:#x}",
+            "row": row,
+            "shard": shard,
+            "members": list(meta.members),
+            "version": meta.version,
+            "leader": coord,
+            "ballot_num": num,
+            "ballot_changes": int(self._bal_changes[row]),
+            "exec_lag": lag,
+            "acc_hi": int(self._acc_hi[row]),
+            "exec_cursor_host": int(self._cur[row]),
+            "ckpt_slot": int(self._ckpt[row]),
+            "stopped": row in self._group_stopped,
+            "wal_segment": shard % self.logger.segments,
+        }
+        if dev:
+            d["promised_bal"] = int(dev["bal"][j])
+            d["coord_bal"] = int(dev["cbal"][j])
+            d["next_slot"] = int(dev["next_slot"][j])
+            d["exec_cursor"] = int(dev["exec_cursor"][j])
+        return d
+
+    def _obs_route(self, path: str):
+        """Introspection routes for the per-node stats listener."""
+        from gigapaxos_tpu.net.statshttp import observability_routes
+        return observability_routes(path, groups_fn=self.groups_info,
+                                    group_fn=self.group_info)
 
     def stats(self) -> str:
         """One-line node counters (ref: the reference's periodic
@@ -2269,9 +2429,15 @@ class PaxosNode:
                 if sb is None:
                     continue
             if RequestInstrumenter.enabled:
-                for i in range(len(sb.req_id)):
-                    RequestInstrumenter.record(int(sb.req_id[i]), "recv",
-                                               self.id)
+                # vectorized survivor selection: one numpy pass per
+                # batch, a Python call only per SAMPLED request — a
+                # 0.1% rate must not cost a per-request loop
+                surv = np.flatnonzero(
+                    RequestInstrumenter.sampled_mask(sb.req_id)
+                    | ((np.asarray(sb.flags) & FLAG_SAMPLED) != 0))
+                for i in surv.tolist():
+                    RequestInstrumenter.record(
+                        int(sb.req_id[i]), "recv", self.id, force=True)
             rows = self._rows_for_keys(sb.gkey)
             bal = self._bal[np.where(rows >= 0, rows, 0)]
             coords = np.where((rows >= 0) & (bal >= 0),
@@ -2361,6 +2527,12 @@ class PaxosNode:
                     # holed every request until the client re-routed)
                     self._park(meta.row, prop)
                 else:
+                    if RequestInstrumenter.enabled:
+                        # send stamp: the entry->coordinator hop of a
+                        # sampled trace is measured fwd@entry -> prop@coord
+                        RequestInstrumenter.record(
+                            o.req_id, "fwd", self.id,
+                            force=bool(o.flags & FLAG_SAMPLED))
                     self._route(coord, prop)
                 continue
             if o.req_id in self._proposed:
@@ -2425,6 +2597,10 @@ class PaxosNode:
                         self._park(meta.row, o)
                     else:
                         self._bounced[o.req_id] = t
+                        if RequestInstrumenter.enabled:
+                            RequestInstrumenter.record(
+                                o.req_id, "fwd", self.id,
+                                force=bool(o.flags & FLAG_SAMPLED))
                         self._route(coord, o)
                 continue
             if o.req_id in self._proposed:
@@ -2470,10 +2646,29 @@ class PaxosNode:
             rid = int(req_ids[i])
             self._proposed[rid] = _InFlight(
                 int(rows[i]), int(slot_arr[i]), int(bal_of[i]), now, now)
-            self._store_payload(rid, int(flag_parts[i]),
-                                bytes(pay_parts[i]))
-            if RequestInstrumenter.enabled:
-                RequestInstrumenter.record(rid, "prop", self.id)
+            fl = int(flag_parts[i])
+            if RequestInstrumenter.enabled and RequestInstrumenter \
+                    .sampled(rid, bool(fl & FLAG_SAMPLED)):
+                # stamp the wire bit at propose time: the accept blobs
+                # carry it (blob byte 0 = flags), so acceptors honor
+                # the sampling verdict without recomputing it — and
+                # even when configured with a different rate
+                fl = fl | FLAG_SAMPLED
+                flag_parts[i] = fl
+                if not RequestInstrumenter.sampled(rid):
+                    # flag-forced but hash-negative: remember it so
+                    # the vectorized dec/com.tx prefilters include it.
+                    # Bounded: ids whose execution never happens here
+                    # (group deleted, leadership lost) would leak —
+                    # forced traces are rare, so on overflow drop the
+                    # lot (the worst case is a missing dec/com.tx
+                    # stamp on an ancient forced trace)
+                    if len(self._forced_traces) >= 4096:
+                        self._forced_traces.clear()
+                    self._forced_traces.add(rid)
+                RequestInstrumenter.record(rid, "prop", self.id,
+                                           force=True)
+            self._store_payload(rid, fl, bytes(pay_parts[i]))
         rej = np.asarray(res.rejected)
         if rej.any():
             for i in np.flatnonzero(rej):
@@ -2522,15 +2717,27 @@ class PaxosNode:
             self.logger.log_raw_inline(wal_buf, n_entries=len(ai),
                                        seg=self._wal_seg())
             if RequestInstrumenter.enabled:
-                for r in req_ids[ai].tolist():
-                    RequestInstrumenter.record(int(r), "acc", self.id)
+                ai_l = ai.tolist()
+                farr = np.fromiter((flags[i] for i in ai_l), np.int64,
+                                   len(ai_l))
+                for k in np.flatnonzero(
+                        RequestInstrumenter.sampled_mask(req_ids[ai])
+                        | ((farr & FLAG_SAMPLED) != 0)).tolist():
+                    RequestInstrumenter.record(
+                        int(req_ids[ai_l[k]]), "acc", self.id,
+                        force=True)
         pre = np.flatnonzero(self_pre)
         if len(pre):
             # our own acceptor outranked us (competitor's prepare landed
             # first): adopt the higher promise; the kernel already
-            # resigned coordinatorship
-            np.maximum.at(self._bal, rows[pre],
-                          np.asarray(self_cur)[pre].astype(np.int32))
+            # resigned coordinatorship.  Churn = rows whose mirror
+            # actually advances (see _rep_post), deduped.
+            rp = rows[pre]
+            cp = np.asarray(self_cur)[pre].astype(np.int32)
+            gain = cp > self._bal[rp]
+            if gain.any():
+                self._note_ballot_change(np.unique(rp[gain]))
+            np.maximum.at(self._bal, rp, cp)
         ni = np.flatnonzero(self_newly)
         if len(ni):
             # single-member quorum: decided on our own vote
@@ -2549,6 +2756,20 @@ class PaxosNode:
         """CommitBatch per member destination for newly decided lanes.
         ``skip_self``: the fused decide wave already applied our own
         commit on-device (host bookkeeping in _after_self_commit)."""
+        if RequestInstrumenter.enabled:
+            # send stamp: coordinator->replica commit hop of a sampled
+            # trace is measured com.tx@coord -> exec@replica.  Hash
+            # prefilter (one numpy pass) + the small forced-trace set;
+            # no per-request payload-dict lookups on this path.
+            creqs = _merge_req(np.asarray(rlo), np.asarray(rhi))
+            mask = RequestInstrumenter.sampled_mask(creqs)
+            FT = self._forced_traces
+            if FT:  # stays vectorized: np.isin, not a Python loop
+                mask = mask | np.isin(
+                    creqs, np.fromiter(FT, np.uint64, len(FT)))
+            for k in np.flatnonzero(mask).tolist():
+                RequestInstrumenter.record(int(creqs[k]), "com.tx",
+                                           self.id, force=True)
         dsts = self._member_mat[nrows]
         for dst in np.unique(dsts):
             if dst < 0 or (skip_self and dst == self.id):
@@ -2576,6 +2797,19 @@ class PaxosNode:
             np.int32)
         hi = (reqs_g >> np.uint64(32)).astype(np.uint32).view(np.int32)
         pls = [bytes([flags[i]]) + payloads[i] for i in gi.tolist()]
+        if RequestInstrumenter.enabled:
+            # send stamp: coordinator->acceptor hop of a sampled trace
+            # is measured acc.tx@coord -> acc@acceptor.  Vectorized
+            # prefilter: hash mask OR the stamped wire bit.
+            gi_l = gi.tolist()
+            farr = np.fromiter((flags[i] for i in gi_l), np.int64,
+                               len(gi_l))
+            surv = np.flatnonzero(
+                RequestInstrumenter.sampled_mask(reqs_g)
+                | ((farr & FLAG_SAMPLED) != 0))
+            for k in surv.tolist():
+                RequestInstrumenter.record(int(reqs_g[k]), "acc.tx",
+                                           self.id, force=True)
         dsts = self._member_mat[rows_g]
         for dst in np.unique(dsts):
             if dst < 0 or (skip_self and dst == self.id):
@@ -2643,9 +2877,16 @@ class PaxosNode:
                 self.logger.log_raw_inline(wal_buf, n_entries=len(ai),
                                        seg=self._wal_seg())
                 if RequestInstrumenter.enabled:
-                    for i in ai.tolist():
-                        RequestInstrumenter.record(int(reqs_all[i]),
-                                                   "acc", self.id)
+                    ai_l = ai.tolist()
+                    farr = np.fromiter(
+                        (b[0] for b in blobs), np.int64, len(blobs))
+                    for k in np.flatnonzero(
+                            RequestInstrumenter.sampled_mask(
+                                reqs_all[ai])
+                            | ((farr & FLAG_SAMPLED) != 0)).tolist():
+                        RequestInstrumenter.record(
+                            int(reqs_all[ai_l[k]]), "acc", self.id,
+                            force=True)
             for dst, arb in out:
                 self._route(dst, arb)
             return
@@ -2720,6 +2961,18 @@ class PaxosNode:
             # the send barrier: nothing acked leaves before durability
             self.logger.log_raw_inline(wal_buf, n_entries=len(ai),
                                        seg=self._wal_seg())
+            if RequestInstrumenter.enabled:
+                # acc = accept fsync-durable at this acceptor (the
+                # arrival stamp the coordinator's acc.tx pairs with)
+                ai_l = ai.tolist()
+                farr = np.fromiter((b[0] for b in blobs), np.int64,
+                                   len(blobs))
+                for k in np.flatnonzero(
+                        RequestInstrumenter.sampled_mask(req_ids[ai])
+                        | ((farr & FLAG_SAMPLED) != 0)).tolist():
+                    RequestInstrumenter.record(
+                        int(req_ids[ai_l[k]]), "acc", self.id,
+                        force=True)
         for dst, arb in out:
             self._route(dst, arb)
 
@@ -2917,12 +3170,36 @@ class PaxosNode:
         decision fan-out, fused self-commit bookkeeping."""
         # preemption: a higher ballot exists; adopt belief, stop leading
         pre = np.asarray(res.preempted)
+        if pre.any():
+            # churn counts BALLOT CHANGES, not preempted lanes: one
+            # leader change preempts every in-flight lane (and every
+            # acceptor's reply repeats it) while the ballot moves once
+            # — count only rows whose mirror actually advances, deduped
+            rp, bp = rows[pre], bals[pre]
+            gain = bp > self._bal[rp]
+            if gain.any():
+                self._note_ballot_change(np.unique(rp[gain]))
         np.maximum.at(self._bal, rows[pre], bals[pre])
         newly = np.asarray(res.newly_decided)
         if not newly.any():
             return
         with self._stat_lock:
             self.n_decided += int(newly.sum())
+        if RequestInstrumenter.enabled:
+            # dec = quorum crossed at the coordinator (same vectorized
+            # prefilter as the com.tx stamp).  NB: no local here may
+            # be named `sel` — that is this function's lane-index
+            # parameter, consumed by the _emit_commits call below
+            dreqs = _merge_req(np.asarray(res.req_lo),
+                               np.asarray(res.req_hi))[newly]
+            mask = RequestInstrumenter.sampled_mask(dreqs)
+            FT = self._forced_traces
+            if FT:  # stays vectorized: np.isin, not a Python loop
+                mask = mask | np.isin(
+                    dreqs, np.fromiter(FT, np.uint64, len(FT)))
+            for k in np.flatnonzero(mask).tolist():
+                RequestInstrumenter.record(int(dreqs[k]), "dec",
+                                           self.id, force=True)
         # decisions -> CommitBatch to each member; with the fused path
         # our own commit already happened on-device, so only the host
         # bookkeeping (WAL, decision dict, execution) remains for self
@@ -3118,8 +3395,12 @@ class PaxosNode:
                     self._group_stopped.add(row)
             n_exec += 1
             PR.pop(req_id, None)
+            if self._forced_traces:
+                self._forced_traces.discard(req_id)
             if RequestInstrumenter.enabled:
-                RequestInstrumenter.record(req_id, "exec", self.id)
+                RequestInstrumenter.record(
+                    req_id, "exec", self.id,
+                    force=bool(flags & FLAG_SAMPLED))
             if status in (0, 4):
                 # APPLIED requests and deterministic app failures both
                 # enter the at-most-once dedup tables: a retransmit of a
@@ -3135,6 +3416,12 @@ class PaxosNode:
             if waiter is not None:
                 self._route(waiter[0], pkt.Response(
                     self.id, meta.gkey, req_id, status, resp))
+                if RequestInstrumenter.enabled:
+                    # request done end-to-end at the answering node:
+                    # feed the slow-request log (waiter[1] = intake ts)
+                    RequestInstrumenter.note_done(
+                        req_id, time.time() - waiter[1],
+                        force=bool(flags & FLAG_SAMPLED))
             cur += 1
         with self._stat_lock:
             self.n_executed += n_exec
@@ -3492,6 +3779,8 @@ class PaxosNode:
             bal, sender = best[row]
             meta = self.table.by_row(row)
             if int(res.cur_bal[i]) > self._bal[row]:
+                # promising a higher ballot = a (would-be) leader change
+                self._note_ballot_change(row)
                 self._bal[row] = int(res.cur_bal[i])
             m = int(np.sum(res.win_slot[i] >= 0))
             slots = res.win_slot[i][:m] if m else np.zeros(0, np.int32)
@@ -3525,7 +3814,9 @@ class PaxosNode:
             rows_ok = rows[ok]
             bals_ok = np.ascontiguousarray(o.bal[ok], np.int32)
             res = self.backend.prepare(rows_ok.astype(np.int32), bals_ok)
-            np.maximum.at(self._bal, rows_ok, np.asarray(res.cur_bal))
+            cur = np.asarray(res.cur_bal)
+            self._note_ballot_change(rows_ok[cur > self._bal[rows_ok]])
+            np.maximum.at(self._bal, rows_ok, cur)
             live = np.asarray(res.win_slot) >= 0  # compacted-left (SPI)
             counts = live.sum(axis=1).astype(np.int32)
             total = int(counts.sum())
@@ -3585,6 +3876,7 @@ class PaxosNode:
             if not o.acked[i]:
                 if bal > el.bal:
                     if bal > self._bal[row]:
+                        self._note_ballot_change(row)
                         self._bal[row] = bal
                     del self._elections[row]
                 continue
@@ -3719,6 +4011,7 @@ class PaxosNode:
             np.full((n, W), NO_SLOT, np.int32), np.zeros((n, W),
                                                          np.uint64))
         self._bal[arr] = bals
+        self._note_ballot_change(arr)
         with self._stat_lock:
             self.n_installs += n
         # reconcile in-flight proposals: with an empty quorum view every
@@ -3766,6 +4059,7 @@ class PaxosNode:
         if not o.acked:
             if o.bal > el.bal:
                 if o.bal > self._bal[row]:
+                    self._note_ballot_change(row)
                     self._bal[row] = o.bal
                 del self._elections[row]
             return
@@ -3825,6 +4119,7 @@ class PaxosNode:
             np.asarray([row], np.int32), np.asarray([el.bal], np.int32),
             np.asarray([next_slot], np.int32), cs, cr)
         self._bal[row] = el.bal
+        self._note_ballot_change(row)
         with self._stat_lock:
             self.n_installs += 1
         log.info("node %d now coordinator of %s at bal %d (carry %d)",

@@ -313,7 +313,16 @@ class PrepareReplyBatch:
 @dataclass
 class Request:
     """Client → entry replica (ref: ``RequestPacket``).  ``req_id`` is
-    globally unique: (client_id << 32 | seqno) by convention."""
+    globally unique: (client_id << 32 | seqno) by convention — which is
+    also why it doubles as the request's cluster TRACE ID: the hot
+    batch packets (AcceptBatch/CommitBatch/PrepareReplyBatch windows)
+    already carry req ids end to end, so the trace context propagates
+    through every SoA and shard-split path with zero new wire bytes.
+
+    ``flags`` bits ride the wire in Request/Proposal AND as byte 0 of
+    each accept payload blob, so downstream acceptors see them too.
+    Old nodes ignore unknown bits (the byte always existed) — adding
+    FLAG_SAMPLED is wire-compatible both directions."""
 
     sender: int
     gkey: int
@@ -324,6 +333,9 @@ class Request:
     TYPE = PacketType.REQUEST
     _S = struct.Struct("<QQB")
     FLAG_STOP = 1
+    # client-forced trace sampling (bits 1/2 are the node-internal
+    # NOOP/MISSING markers — see manager.FLAG_NOOP/FLAG_MISSING)
+    FLAG_SAMPLED = 8
 
     def encode(self) -> bytes:
         return (_HDR.pack(self.TYPE, self.sender, 1) +

@@ -119,6 +119,24 @@ class PC(ConfigKey):
     # RequestInstrumenter at FINE level): records recv/prop/acc/dec/exec
     # events into utils.instrument.RequestInstrumenter's global ring
     TRACE_REQUESTS = False
+    # cluster tracing plane: fraction of client requests traced across
+    # the whole deployment (0 = off, 1 = everything; 0.01 = 1%).  The
+    # verdict is a deterministic hash of the req_id (= trace id), so
+    # every node samples the SAME requests with zero propagated bytes;
+    # a client can force one trace via the Request.FLAG_SAMPLED wire
+    # bit.  Unsampled requests leave no ring entries — the hot path
+    # pays one attribute check per hook.
+    TRACE_SAMPLE = 0.0
+    # age horizon for trace-ring entries and spans (seconds): events
+    # and spans older than this are evicted, and spans whose end stamp
+    # never arrived are moved to the explicit `orphaned` counter
+    # instead of skewing the begun/ended pairing forever.  0 disables.
+    TRACE_MAX_AGE_S = 300.0
+    # slow-request log: sampled requests slower than this many seconds
+    # end to end enter a bounded top-K table (0 disables), surfaced in
+    # metrics()["slow_traces"] and dumped by utils/statsdump.py
+    SLOW_TRACE_S = 0.0
+    SLOW_TRACE_K = 32
     # observability plane (ref: the reference's periodic DelayProfiler/
     # NIOInstrumenter dumps + gigaPaxos' instrumentation endpoints):
     # STATS_PORT >= 0 starts the per-node HTTP stats listener on that
@@ -130,3 +148,7 @@ class PC(ConfigKey):
     # JSONL into the node's logdir
     STATS_DUMP_S = 0.0
     STATS_JSON = False
+    # cluster aggregation (the gateway's /cluster/* fan-out): the
+    # per-node stats listeners to scrape, as "id=host:port,id=host:
+    # port".  Empty = the gateway serves only its local process view.
+    STATS_PEERS = ""

@@ -17,6 +17,14 @@ Routes::
     GET  /metrics   Prometheus text exposition (process metrics, or a
                     node's metrics() via the ``metrics_source`` hook)
     GET  /stats     the same metrics as one JSON snapshot
+    GET  /groups            per-group consensus health (co-located node)
+    GET  /groups/NAME       one group's health detail
+    GET  /traces/ID         this process's share of one sampled trace
+    GET  /cluster/metrics   ONE scrape point for the deployment: fan
+                            out to every PC.STATS_PEERS node's /stats,
+                            merge (histograms bucket-wise), render
+    GET  /cluster/stats     the merged snapshot as JSON
+    GET  /cluster/traces/ID cross-node stitched trace breakdown
 
 Run standalone::
 
@@ -44,7 +52,7 @@ class HttpFrontend:
 
     def __init__(self, config: NodeConfig, listen: Tuple[str, int],
                  client_id: int = (1 << 21) + 7, timeout: float = 10.0,
-                 metrics_source=None):
+                 metrics_source=None, obs_node=None, stats_peers=None):
         self.config = config
         self.listen = listen
         self.cli = ReconfigurableAppClient(client_id, config,
@@ -52,6 +60,18 @@ class HttpFrontend:
         # /metrics and /stats source: a co-located node's metrics()
         # when deployed next to one, else the process-global profiler
         self.metrics_source = metrics_source
+        # /groups introspection source: a co-located PaxosNode (or any
+        # object with groups_info/group_info)
+        self.obs_node = obs_node
+        # /cluster/* fan-out targets: {node_id: (host, stats_port)};
+        # default from PC.STATS_PEERS ("id=host:port,...")
+        if stats_peers is None:
+            from gigapaxos_tpu.net.cluster import parse_stats_peers
+            from gigapaxos_tpu.paxos.paxosconfig import PC
+            from gigapaxos_tpu.utils.config import Config
+            stats_peers = parse_stats_peers(
+                str(Config.get(PC.STATS_PEERS)))
+        self.stats_peers = dict(stats_peers)
         self._server: Optional[asyncio.base_events.Server] = None
 
     async def start(self) -> None:
@@ -154,6 +174,21 @@ class HttpFrontend:
                                                       process_metrics)
                 return metrics_response(
                     path, self.metrics_source or process_metrics)
+            if method == "GET" and (path.startswith("/groups")
+                                    or path.startswith("/traces/")):
+                from gigapaxos_tpu.net.statshttp import \
+                    observability_routes
+                node = self.obs_node
+                resp = observability_routes(
+                    path,
+                    groups_fn=node.groups_info if node else None,
+                    group_fn=node.group_info if node else None)
+                if resp is not None:
+                    return resp
+            if method == "GET" and path.startswith("/cluster/"):
+                resp = await self._route_cluster(path)
+                if resp is not None:
+                    return resp
             if method == "GET" and path.startswith("/actives/"):
                 name = path[len("/actives/"):]
                 try:
@@ -200,6 +235,35 @@ class HttpFrontend:
             log.exception("http route %s %s failed", method, path)
             return ("500 Internal Server Error", "application/json",
                     b'{"err":"internal"}')
+
+    async def _route_cluster(self, path: str
+                             ) -> Optional[Tuple[str, str, bytes]]:
+        """The cluster aggregation plane: fan out to every configured
+        node's stats listener and merge.  With no peers configured the
+        merge degenerates to an empty roster (the local process view
+        stays on /metrics — /cluster/* answers for the fleet only)."""
+        from gigapaxos_tpu.net.cluster import (cluster_trace,
+                                               merge_cluster_stats,
+                                               scrape_cluster)
+        from gigapaxos_tpu.net.statshttp import parse_trace_id
+        if path in ("/cluster/metrics", "/cluster/stats"):
+            per_node = await scrape_cluster(self.stats_peers, "/stats")
+            merged = merge_cluster_stats(per_node)
+            if path == "/cluster/stats":
+                return ("200 OK", "application/json",
+                        json.dumps(merged, default=str).encode())
+            from gigapaxos_tpu.utils.prom import render_prometheus
+            return ("200 OK", "text/plain; version=0.0.4",
+                    render_prometheus(merged).encode())
+        if path.startswith("/cluster/traces/"):
+            tid = parse_trace_id(path[len("/cluster/traces/"):])
+            if tid is None:
+                return ("400 Bad Request", "application/json",
+                        b'{"err":"bad trace id"}')
+            out = await cluster_trace(self.stats_peers, tid)
+            return ("200 OK", "application/json",
+                    json.dumps(out, default=str).encode())
+        return None
 
 
 def main(argv=None) -> int:

@@ -113,6 +113,22 @@ def main(argv=None) -> int:
     p.add_argument("--stats-json", action="store_true",
                    help="with --stats-every, also append full JSON "
                         "metrics snapshots to <logdir>/stats<id>.jsonl")
+    p.add_argument("--trace-sample", type=float, default=None,
+                   help="cluster tracing plane: fraction of requests "
+                        "traced across nodes (0..1; deterministic in "
+                        "the req id so all nodes sample the same "
+                        "requests; or TRACE_SAMPLE= in the properties "
+                        "file; default 0 = off)")
+    p.add_argument("--slow-trace-ms", type=float, default=None,
+                   help="log sampled requests slower than this many ms "
+                        "end-to-end into the bounded slow-trace table "
+                        "(or SLOW_TRACE_MS= in the properties file; "
+                        "0 = off)")
+    p.add_argument("--stats-peers", default=None,
+                   help='cluster fan-out map for the gateway\'s '
+                        '/cluster/* routes: "id=host:port,..." of every '
+                        "node's stats listener (or STATS_PEERS= in the "
+                        "properties file)")
     args = p.parse_args(argv)
 
     extras = read_extras(args.config)
@@ -156,6 +172,20 @@ def main(argv=None) -> int:
     if stats_every > 0:
         Config.set(PC.STATS_DUMP_S, stats_every)
         Config.set(PC.STATS_JSON, stats_json)
+    trace_sample = args.trace_sample if args.trace_sample is not None \
+        else (float(extras["TRACE_SAMPLE"])
+              if "TRACE_SAMPLE" in extras else None)
+    if trace_sample is not None:
+        Config.set(PC.TRACE_SAMPLE, trace_sample)
+    slow_ms = args.slow_trace_ms if args.slow_trace_ms is not None \
+        else (float(extras["SLOW_TRACE_MS"])
+              if "SLOW_TRACE_MS" in extras else None)
+    if slow_ms is not None:
+        Config.set(PC.SLOW_TRACE_S, slow_ms / 1e3)
+    stats_peers = args.stats_peers if args.stats_peers is not None \
+        else extras.get("STATS_PEERS")
+    if stats_peers is not None:
+        Config.set(PC.STATS_PEERS, stats_peers)
 
     if args.paxos_only:
         # PaxosServer-style deployment: the engine without the control

@@ -30,6 +30,23 @@ from gigapaxos_tpu.paxos.packets import group_key
 from gigapaxos_tpu.testing.harness import PaxosEmulation
 
 
+def _cluster_health(emu) -> dict:
+    """End-of-run consensus-health rollup across the emulation's live
+    nodes (ballot churn + exec lag — the probe-timeline fields
+    tpu_watch records next to the latency tails)."""
+    out = {"ballot_changes": 0, "installs": 0, "exec_lag_max": 0}
+    for nd in emu.nodes.values():
+        if nd is None:
+            continue
+        m = nd.metrics(include_profiler=False)
+        out["ballot_changes"] += m["counters"].get("ballot_changes", 0)
+        out["installs"] += m["counters"].get("installs", 0)
+        out["exec_lag_max"] = max(
+            out["exec_lag_max"],
+            nd._groups_health().get("exec_lag_max", 0))
+    return out
+
+
 def _totals_delta(before: dict, after: dict) -> dict:
     """Per-stage budget split over one measurement window: wall s, CPU
     s, calls, items for every ``w.*``/``node.*`` DelayProfiler total
@@ -145,6 +162,7 @@ def mode_throughput(args) -> dict:
         # stage budgets AND tails live in the one emitted artifact, so
         # render_perf.py can print both without a re-run
         stats["profiler"] = DelayProfiler.snapshot(buckets=False)
+        stats["consensus_health"] = _cluster_health(emu)
         if args.on_device:
             stats["device_dispatch_rtt_ms"] = _dispatch_rtt_ms()
         return {

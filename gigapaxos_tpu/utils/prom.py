@@ -122,7 +122,10 @@ def render_prometheus(m: dict, prefix: str = "gp") -> str:
             ("parked", "proposals parked awaiting leadership"),
             ("park_dropped", "parked proposals dropped at cap"),
             ("shed", "requests answered retry by the backlog guard"),
-            ("installs", "coordinator installs won (failover)")):
+            ("installs", "coordinator installs won (failover)"),
+            ("ballot_changes",
+             "ballot/leader churn: new ballots adopted across groups "
+             "(elections won, preemptions, higher-ballot promises)")):
         if key in c:
             w.family(f"{p}_{key}_total", "counter", help_,
                      [(None, c[key])])
@@ -137,6 +140,27 @@ def render_prometheus(m: dict, prefix: str = "gp") -> str:
         w.family(f"{p}_backlog_frames", "gauge",
                  "estimated inbound backlog in frames",
                  [(None, c["backlog_est"])])
+
+    gh = m.get("groups_health")
+    if gh:
+        # exec lag = accepted-but-unexecuted slots (consensus health:
+        # a growing lag means commits are lost or the app is behind)
+        w.family(f"{p}_exec_lag_slots", "gauge",
+                 "accepted-but-not-yet-executed slots across groups",
+                 [({"agg": "max"}, gh.get("exec_lag_max")),
+                  ({"agg": "sum"}, gh.get("exec_lag_sum")),
+                  ({"agg": "mean"}, gh.get("exec_lag_mean"))])
+        w.family(f"{p}_ballot_changes_max", "gauge",
+                 "worst per-group ballot churn count",
+                 [(None, gh.get("ballot_changes_max"))])
+    wal = m.get("wal", {})
+    segs = wal.get("segments")
+    if segs:
+        w.family(f"{p}_wal_segment_bytes", "gauge",
+                 "bytes in each WAL segment since its last compaction "
+                 "rewrite (segment lag toward the compact threshold)",
+                 [({"segment": str(s.get("segment"))}, s.get("bytes"))
+                  for s in segs])
 
     eng = m.get("engine")
     if eng is not None:
@@ -167,6 +191,13 @@ def render_prometheus(m: dict, prefix: str = "gp") -> str:
         w.family(f"{p}_net_dropped_frames_total", "counter",
                  "outbound frames dropped, by cause",
                  [({"cause": k}, v) for k, v in sorted(drops.items())])
+    rtt = net.get("rtt")
+    if rtt:
+        w.family(f"{p}_net_rtt_seconds", "gauge",
+                 "ping/pong round-trip EWMA per peer (the network-hop "
+                 "baseline for cross-node traces)",
+                 [({"peer": str(peer)}, v.get("ewma_s"))
+                  for peer, v in sorted(rtt.items())])
 
     prof = m.get("profiler", m if "totals" in m else {})
     totals = prof.get("totals", {})
@@ -217,8 +248,21 @@ def render_prometheus(m: dict, prefix: str = "gp") -> str:
     if spans:
         w.family(f"{p}_spans_open", "gauge",
                  "spans begun but not yet ended",
-                 [(None, max(0, spans.get("begun", 0)
-                             - spans.get("ended", 0)))])
+                 [(None, spans.get(
+                     "open", max(0, spans.get("begun", 0)
+                                 - spans.get("ended", 0))))])
+        if "orphaned" in spans:
+            w.family(f"{p}_spans_orphaned_total", "counter",
+                     "spans whose end stamp never arrived within the "
+                     "trace age horizon (a stage lost its end)",
+                     [(None, spans.get("orphaned"))])
+
+    cluster = m.get("cluster")
+    if cluster:
+        w.family(f"{p}_node_up", "gauge",
+                 "per-node scrape success in the cluster fan-out",
+                 [({"node": str(n)}, up)
+                  for n, up in sorted(cluster.get("nodes", {}).items())])
 
     return w.render()
 

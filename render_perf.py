@@ -157,15 +157,32 @@ def render() -> str:
                        if t.startswith("w.process@"))
         if lanes:
             walls = [v.get("wall_s", 0.0) for _k, v in lanes]
-            skew = (max(walls) / max(min(walls), 1e-9)) \
-                if min(walls) > 0 else float("inf")
-            cells = " ".join(f"s{k}={v.get('wall_s', 0.0):.2f}s/"
-                             f"{v.get('items', 0)}i"
-                             for k, v in lanes)
-            out.append(
-                f"| Engine-lane balance ({len(lanes)} shards, "
-                "`w.process@<k>` wall s / items) | "
-                f"{cells} — max/min skew {skew:.2f}x |")
+            cells = " ".join(
+                f"s{k}=idle" if v.get("wall_s", 0.0) == 0
+                else f"s{k}={v.get('wall_s', 0.0):.2f}s/"
+                     f"{v.get('items', 0)}i"
+                for k, v in lanes)
+            busy = [w for w in walls if w > 0]
+            if len(busy) < len(walls):
+                # a shard saw no waves in the window: a numeric skew
+                # would be a divide-by-zero "inf" — name the idle lanes
+                # instead, and skew over the active ones only
+                idle = [f"s{k}" for k, v in lanes
+                        if v.get("wall_s", 0.0) == 0]
+                skew_txt = (f"active-lane skew "
+                            f"{max(busy) / min(busy):.2f}x, "
+                            if len(busy) >= 2 else "")
+                out.append(
+                    f"| Engine-lane balance ({len(lanes)} shards, "
+                    "`w.process@<k>` wall s / items) | "
+                    f"{cells} — {skew_txt}"
+                    f"idle: {', '.join(idle)} |")
+            else:
+                skew = max(walls) / min(walls)
+                out.append(
+                    f"| Engine-lane balance ({len(lanes)} shards, "
+                    "`w.process@<k>` wall s / items) | "
+                    f"{cells} — max/min skew {skew:.2f}x |")
 
     r = row("config2_columnar_100k_groups_host_xla_knee")
     if r:

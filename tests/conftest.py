@@ -63,5 +63,6 @@ def _clean_profiler():
     from gigapaxos_tpu.utils.profiler import DelayProfiler
     yield
     DelayProfiler.clear()
-    RequestInstrumenter.enabled = False
-    RequestInstrumenter.clear()
+    # reset() also restores the trace-plane knobs (sample rate, age
+    # horizon, slow log) a test may have configured via PC.TRACE_*
+    RequestInstrumenter.reset()

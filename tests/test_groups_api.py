@@ -1,0 +1,278 @@
+"""Consensus-health introspection + cluster aggregation (PR 5):
+``GET /groups`` / ``/groups/<id>`` schema, merged-histogram exactness
+for ``/cluster/metrics``, gateway fan-out over real per-node stats
+listeners, and the ballot-churn counter across a forced leader
+change."""
+
+import asyncio
+import json
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from gigapaxos_tpu.ops.types import unpack_ballot
+from gigapaxos_tpu.paxos.client import PaxosClient
+from gigapaxos_tpu.paxos.interfaces import NoopApp
+from gigapaxos_tpu.paxos.manager import PaxosNode
+from gigapaxos_tpu.paxos.packets import group_key
+from gigapaxos_tpu.paxos.paxosconfig import PC
+from gigapaxos_tpu.testing.harness import free_ports
+from gigapaxos_tpu.utils.config import Config
+
+from tests.conftest import tscale
+from tests.test_e2e import make_cluster, shutdown
+from tests.test_metrics_format import _get, _validate_exposition
+
+# every group dict a /groups scrape returns must carry at least these
+GROUP_KEYS = {
+    "name", "gkey", "row", "shard", "members", "version", "leader",
+    "ballot_num", "ballot_changes", "exec_lag", "acc_hi",
+    "exec_cursor_host", "ckpt_slot", "stopped", "wal_segment",
+    "promised_bal", "coord_bal", "next_slot", "exec_cursor",
+}
+
+
+@pytest.mark.smoke
+def test_groups_endpoints_schema(tmp_path):
+    """Single in-process node: /groups summary + /groups/<id> detail
+    carry the full schema with device-truth cursors, and the new
+    health families show up on /metrics."""
+    Config.set(PC.STATS_PORT, 0)
+    Config.set(PC.TRACE_SAMPLE, 1.0)
+    addr = {0: ("127.0.0.1", free_ports(1)[0])}
+    node = PaxosNode(0, addr, NoopApp(), str(tmp_path), backend="native")
+    node.start()
+    cli = None
+    try:
+        for k in range(4):
+            assert node.create_group(f"gi{k}", (0,))
+        cli = PaxosClient([addr[0]], timeout=tscale(10))
+        rids = [cli.send_request("gi0", f"x{k}".encode()).req_id
+                for k in range(5)]
+        port = node.stats_http.port
+
+        st, body = _get(port, "/groups")
+        assert st == 200
+        d = json.loads(body)
+        assert d["count"] == 4 and d["returned"] == 4
+        assert d["truncated"] is False
+        for g in d["groups"]:
+            assert GROUP_KEYS <= set(g), set(g)
+        # limit + truncation flag
+        st, body = _get(port, "/groups?limit=2")
+        d2 = json.loads(body)
+        assert d2["returned"] == 2 and d2["truncated"] is True
+
+        st, body = _get(port, "/groups/gi0")
+        g = json.loads(body)
+        assert GROUP_KEYS <= set(g)
+        assert g["leader"] == 0 and g["members"] == [0]
+        assert g["exec_cursor"] == 5  # device truth: 5 executed slots
+        assert g["exec_cursor_host"] == 5
+        assert g["exec_lag"] == 0 and g["stopped"] is False
+        # lookup by hex gkey too
+        st, body = _get(port, f"/groups/{group_key('gi0'):#x}")
+        assert json.loads(body)["name"] == "gi0"
+        try:
+            _get(port, "/groups/nope")
+            assert False, "expected 404"
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+
+        # /traces/<id>: the per-node export the cluster stitch pulls
+        st, body = _get(port, f"/traces/{rids[0]}")
+        tr = json.loads(body)
+        assert tr["trace_id"] == rids[0]
+        assert {e[0] for e in tr["events"]} >= {"recv", "prop", "acc",
+                                                "exec"}
+        assert tr["breakdown"]["total_s"] >= 0
+        try:
+            _get(port, "/traces/zzz")
+            assert False, "expected 400"
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+
+        # new health families render on /metrics (format-guarded)
+        st, body = _get(port, "/metrics")
+        series = _validate_exposition(body.decode())
+        assert "gp_ballot_changes_total" in series
+        assert 'gp_exec_lag_slots{agg="max"}' in series
+        assert 'gp_wal_segment_bytes{segment="0"}' in series
+        # /stats carries the structured health + wal sections
+        st, body = _get(port, "/stats")
+        m = json.loads(body)
+        assert m["groups_health"]["groups"] == 4
+        assert m["wal"]["segments"][0]["segment"] == 0
+        assert "orphaned" in m["spans"] and "open" in m["spans"]
+    finally:
+        if cli is not None:
+            cli.close()
+        node.stop()
+
+
+@pytest.mark.smoke
+def test_cluster_metrics_merge_exactness():
+    """Merged histograms must be EXACT bucket-wise sums of the per-node
+    snapshots (cluster-true percentiles, not an average of averages),
+    and counters must sum."""
+    from gigapaxos_tpu.net.cluster import merge_cluster_stats
+    from gigapaxos_tpu.utils.profiler import (_Hist, hist_percentile,
+                                              merge_hist_snapshots)
+    import random
+
+    rng = random.Random(7)
+    h1, h2 = _Hist(), _Hist()
+    all_samples = []
+    for h, n in ((h1, 400), (h2, 300)):
+        for _ in range(n):
+            s = rng.uniform(1e-5, 0.2)
+            h.record(s)
+            all_samples.append(s)
+    m1 = {"counters": {"decided": 10, "executed": 9},
+          "profiler": {"histograms": {"node.batch": h1.snapshot()}},
+          "groups_health": {"exec_lag_max": 3, "exec_lag_sum": 5}}
+    m2 = {"counters": {"decided": 32, "executed": 30},
+          "profiler": {"histograms": {"node.batch": h2.snapshot()}},
+          "groups_health": {"exec_lag_max": 1, "exec_lag_sum": 2}}
+    merged = merge_cluster_stats({0: m1, 1: m2, 2: None})
+
+    assert merged["counters"] == {"decided": 42, "executed": 39}
+    assert merged["cluster"]["nodes"] == {0: 1, 1: 1, 2: 0}
+    assert merged["groups_health"]["exec_lag_max"] == 3  # max, not sum
+    assert merged["groups_health"]["exec_lag_sum"] == 7
+
+    got = merged["profiler"]["histograms"]["node.batch"]
+    want = merge_hist_snapshots(h1.snapshot(), h2.snapshot())
+    assert got["count"] == 700 == want["count"]
+    assert got["buckets"] == want["buckets"]
+    assert got["sum_s"] == pytest.approx(sum(all_samples))
+    # percentile over the merged buckets matches the true sorted oracle
+    # within the histogram's resolution (~9% relative at SUB=4)
+    all_samples.sort()
+    oracle_p50 = all_samples[int(0.5 * len(all_samples))]
+    assert hist_percentile(got, 50) == pytest.approx(oracle_p50,
+                                                     rel=0.15)
+
+
+def test_gateway_cluster_fanout(tmp_path):
+    """The gateway's /cluster/metrics //cluster/stats //cluster/traces
+    fan out to every node's real stats listener and merge: one scrape
+    point for the whole deployment."""
+    Config.set(PC.STATS_PORT, 0)
+    Config.set(PC.TRACE_SAMPLE, 1.0)
+    nodes, addr_map = make_cluster(tmp_path, backend="native")
+    cli = None
+    try:
+        for nd in nodes:
+            assert nd.create_group("cf", (0, 1, 2))
+        cli = PaxosClient([addr_map[i] for i in range(3)],
+                          timeout=tscale(10))
+        rid = None
+        for k in range(6):
+            r = cli.send_request("cf", f"x{k}".encode())
+            assert r.status == 0
+            rid = r.req_id
+        time.sleep(0.3)  # let the commit wave finish on every replica
+        peers = {i: ("127.0.0.1", nd.stats_http.port)
+                 for i, nd in enumerate(nodes)}
+        # a dead peer must read as up=0, not break the scrape
+        peers[9] = ("127.0.0.1", 1)
+
+        from gigapaxos_tpu.net.cluster import (cluster_trace,
+                                               merge_cluster_stats,
+                                               scrape_cluster)
+
+        async def body():
+            per_node = await scrape_cluster(peers, "/stats",
+                                            timeout=tscale(5))
+            merged = merge_cluster_stats(per_node)
+            assert merged["cluster"]["nodes"][9] == 0
+            assert all(merged["cluster"]["nodes"][i] == 1
+                       for i in range(3))
+            # decisions happen once per node: the cluster sum is the
+            # sum of the three per-node counters, exactly
+            want = sum(per_node[i]["counters"]["decided"]
+                       for i in range(3))
+            assert merged["counters"]["decided"] == want >= 6
+            hist = merged["profiler"]["histograms"]["node.batch"]
+            assert hist["count"] == sum(
+                per_node[i]["profiler"]["histograms"]["node.batch"]
+                ["count"] for i in range(3))
+            # prometheus render of the merged dict stays well-formed
+            from gigapaxos_tpu.utils.prom import render_prometheus
+            series = _validate_exposition(render_prometheus(merged))
+            assert series['gp_node_up{node="9"}'] == 0
+            assert series['gp_node_up{node="0"}'] == 1
+            assert series["gp_decided_total"] >= 6
+
+            # cross-node trace stitch through the real listeners
+            out = await cluster_trace(peers, rid, timeout=tscale(5))
+            bd = out["breakdown"]
+            assert out["nodes_scraped"][9] == 0
+            stages = {p["stage"] for p in bd["path"]}
+            assert {"prop", "acc", "dec", "exec"} <= stages, stages
+            assert bd["total_s"] > 0
+        asyncio.run(body())
+        cli.close()
+        cli = None
+    finally:
+        if cli is not None:
+            cli.close()
+        shutdown([nd for nd in nodes if not nd._stopping])
+
+
+def test_ballot_churn_counter_on_leader_change(tmp_path):
+    """Killing the coordinator forces an election: the survivors'
+    ballot-churn counters increment and /groups reports the new
+    leader with a bumped per-group ballot_changes."""
+    Config.set(PC.PING_INTERVAL_S, 0.15)
+    Config.set(PC.FAILURE_TIMEOUT_S, 1.0)
+    nodes, addr_map = make_cluster(tmp_path, backend="native")
+    cli = None
+    try:
+        name = "churn-g"
+        for nd in nodes:
+            assert nd.create_group(name, (0, 1, 2))
+        dead = group_key(name) % 3  # deterministic initial coordinator
+        live = [nd for i, nd in enumerate(nodes) if i != dead]
+        assert all(nd.n_ballot_changes == 0 for nd in nodes)
+        cli = PaxosClient([addr_map[i] for i in range(3) if i != dead],
+                          timeout=tscale(4))
+        assert cli.send_request(name, b"pre").status == 0
+        time.sleep(0.5)  # survivors hear pings before the crash
+        nodes[dead].stop()
+        ok = 0
+        for k in range(10):
+            try:
+                ok += int(cli.send_request(
+                    name, f"post-{k}".encode()).status == 0)
+            except TimeoutError:
+                pass
+        assert ok >= 8, f"only {ok}/10 survived failover"
+        deadline = time.time() + tscale(10)
+        while time.time() < deadline:
+            row = live[0].table.by_name(name).row
+            _num, coord = unpack_ballot(int(live[0]._bal[row]))
+            if coord != dead and sum(nd.n_ballot_changes
+                                     for nd in live) > 0:
+                break
+            time.sleep(0.05)
+        assert coord != dead
+        churn = sum(nd.n_ballot_changes for nd in live)
+        assert churn > 0, "leader change left ballot churn at 0"
+        # the introspection plane agrees: new leader + per-group churn
+        info = live[0].group_info(name)
+        assert info["leader"] == coord != dead
+        total_per_group = sum(nd.group_info(name)["ballot_changes"]
+                              for nd in live)
+        assert total_per_group > 0
+        m = live[0].metrics()
+        assert m["counters"]["ballot_changes"] == \
+            live[0].n_ballot_changes
+        assert m["groups_health"]["ballot_changes_max"] >= 0
+    finally:
+        if cli is not None:
+            cli.close()
+        shutdown([nd for nd in nodes if not nd._stopping])

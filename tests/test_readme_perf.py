@@ -34,3 +34,42 @@ def test_no_stray_round_header():
     assert not re.search(r"## Measured performance \(2026-\d\d, "
                          r"round \d\)", readme), \
         "hand-stamped perf header — the generated block carries the date"
+
+
+def test_lane_balance_idle_shard_renders_idle(tmp_path, monkeypatch):
+    """Satellite (PR 5): a shard with zero waves in the window used to
+    drive the max/min skew into a divide-by-zero "inf" — idle lanes
+    must render as `idle`, with skew over the active lanes only."""
+    import json
+    snap = {
+        "histograms": {"node.batch": {"count": 2, "p50_s": 1e-3,
+                                      "p99_s": 2e-3},
+                       "wal.fsync": {"count": 2, "p50_s": 1e-3,
+                                     "p99_s": 2e-3}},
+        "totals": {"w.process@0": {"wall_s": 2.0, "items": 10},
+                   "w.process@1": {"wall_s": 0.0, "items": 0},
+                   "w.process@2": {"wall_s": 1.0, "items": 5}},
+    }
+    full = {"recorded_at": "t", "rows": {
+        "config1_e2e_3r_1k_groups": {
+            "metric": "m", "value": 1000.0,
+            "info": {"latency_point": {}, "profiler": snap}}}}
+    with open(os.path.join(tmp_path, "BENCH_FULL.json"), "w") as f:
+        json.dump(full, f)
+    monkeypatch.setattr(render_perf, "HERE", str(tmp_path))
+    out = render_perf.render()
+    lane_row = next(ln for ln in out.splitlines()
+                    if "Engine-lane balance" in ln)
+    assert "s1=idle" in lane_row
+    assert "inf" not in lane_row
+    assert "active-lane skew 2.00x" in lane_row
+    assert "idle: s1" in lane_row
+
+    # all-active lanes keep the plain max/min skew cell
+    snap["totals"]["w.process@1"] = {"wall_s": 4.0, "items": 9}
+    with open(os.path.join(tmp_path, "BENCH_FULL.json"), "w") as f:
+        json.dump(full, f)
+    out = render_perf.render()
+    lane_row = next(ln for ln in out.splitlines()
+                    if "Engine-lane balance" in ln)
+    assert "max/min skew 4.00x" in lane_row and "idle" not in lane_row

@@ -155,6 +155,16 @@ def capture_ondevice(timeout_s: int = 900) -> dict:
                    .get("wal.fsync", {}))
             if wal.get("p99_s") is not None:
                 rec["ondevice_wal_p99_ms"] = round(1e3 * wal["p99_s"], 2)
+            # consensus-health fields (PR 5): ballot churn + exec lag
+            # from the run's end-of-run node rollup — a probe timeline
+            # where churn suddenly rises flags leader instability long
+            # before throughput shows it
+            health = info.get("consensus_health", {})
+            if health:
+                rec["ondevice_ballot_churn"] = health.get(
+                    "ballot_changes", 0)
+                rec["ondevice_exec_lag_max"] = health.get(
+                    "exec_lag_max", 0)
             return rec
         return {"ondevice": "rc_%d" % res.returncode,
                 "ondevice_wall_s": round(time.time() - t0, 1)}
