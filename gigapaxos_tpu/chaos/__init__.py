@@ -1,0 +1,31 @@
+"""Chaos plane: deterministic fault injection + scenario harness.
+
+The north star says "as many scenarios as you can imagine" — this
+package is where those scenarios live.  Three layers:
+
+- :mod:`faults` — the **fault plane**: a process-global
+  :class:`~gigapaxos_tpu.chaos.faults.ChaosPlane` hooked into the
+  transport's send path (``net/transport.py``), shaping *peer* links
+  with per-pair delay/jitter, probabilistic drop, reorder, and
+  full/asymmetric partitions.  Every decision comes from a PRNG seeded
+  by ``PC.CHAOS_SEED`` and the (src, dst) pair, so a failing run
+  replays exactly.  Disabled (the default) it costs the hot path one
+  class-attribute check — the same short-circuit discipline as the
+  tracing plane.
+- :mod:`scenarios` — the **scenario runner**: staged timelines
+  (partition-then-heal, leader crash mid-load, rolling restarts,
+  crash-recovery storms across an ``ENGINE_SHARDS`` change, zipf-skewed
+  hot groups) driven against an in-process cluster with real loopback
+  sockets, emitting one ``CHAOS_*.json`` row per scenario.
+- :mod:`invariants` — the **invariant checker**: no acked request
+  lost, per-group digest linearizability across the cluster, exec
+  cursors converged after heal, ballot churn back to steady state —
+  read through the same ``/groups`` + ``/stats`` surfaces an operator
+  would use (PR 5's instruments, now pointed at provoked faults).
+
+CLI::
+
+    python -m gigapaxos_tpu.chaos --scenarios partition_heal --seed 1
+"""
+
+from gigapaxos_tpu.chaos.faults import ChaosPlane  # noqa: F401

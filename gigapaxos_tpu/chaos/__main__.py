@@ -1,0 +1,92 @@
+"""Scenario CLI: ``python -m gigapaxos_tpu.chaos``.
+
+Runs the named chaos scenarios against an in-process cluster, prints
+one JSON line per scenario, and (with ``--out``) writes the rows as a
+``CHAOS_*.json`` artifact — the robustness counterpart of the
+``BENCH_*.json`` perf artifacts (``render_perf.py`` renders both).
+
+Examples::
+
+    # the full drill, deterministic under seed 1
+    python -m gigapaxos_tpu.chaos --seed 1 --out CHAOS_r01.json
+
+    # one scenario, replaying a failing seed
+    python -m gigapaxos_tpu.chaos --scenarios leader_crash --seed 42
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+from gigapaxos_tpu.chaos.scenarios import SCENARIOS, run_scenario
+
+# the full drill (the default): every full-size scenario; 'all' adds
+# mini_partition_heal, the smoke-gate variant of partition_heal
+DEFAULT = ["partition_heal", "leader_crash", "rolling_restart",
+           "shard_storm", "zipf_hot"]
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="gigapaxos_tpu.chaos")
+    p.add_argument("--scenarios", default=",".join(DEFAULT),
+                   help="comma-separated scenario names, or 'all' = "
+                        "every known scenario "
+                        f"(known: {', '.join(sorted(SCENARIOS))}; "
+                        "default: the full drill, which skips the "
+                        "smoke-gate mini variant)")
+    p.add_argument("--seed", type=int, default=1,
+                   help="chaos PRNG seed — the same seed replays the "
+                        "same fault schedule (row carries the "
+                        "schedule fingerprint to prove it)")
+    p.add_argument("--out", default=None,
+                   help="write rows as a CHAOS_*.json artifact")
+    p.add_argument("--backend", default=None,
+                   help="override each scenario's engine (scalar/"
+                        "native/columnar); shard_storm requires "
+                        "columnar and ignores this")
+    p.add_argument("--list", action="store_true",
+                   help="list scenarios and exit")
+    args = p.parse_args(argv)
+
+    if args.list:
+        for name, spec in sorted(SCENARIOS.items()):
+            print(f"{name}: {spec['n_nodes']} nodes, "
+                  f"{spec['n_groups']} groups, {spec['backend']}")
+        return 0
+
+    names = sorted(SCENARIOS) if args.scenarios == "all" \
+        else [s.strip() for s in args.scenarios.split(",") if s.strip()]
+    unknown = [s for s in names if s not in SCENARIOS]
+    if unknown:
+        p.error(f"unknown scenario(s): {unknown}")
+
+    rows = []
+    rc = 0
+    for name in names:
+        be = None if name == "shard_storm" else args.backend
+        try:
+            row = run_scenario(name, seed=args.seed, backend=be)
+        except Exception as exc:  # noqa: BLE001 — one scenario's boot
+            # failure must not discard the completed rows or the --out
+            # artifact; an error row keeps the failure visible
+            import traceback
+            traceback.print_exc()
+            row = {"scenario": name, "seed": args.seed, "ok": False,
+                   "error": f"{type(exc).__name__}: {exc}"}
+        rows.append(row)
+        print(json.dumps(row))
+        if not row.get("ok"):
+            rc = 1
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump({"recorded_at": time.strftime("%Y-%m-%d %H:%M"),
+                       "seed": args.seed, "rows": rows}, f, indent=1)
+        print(f"wrote {args.out}", file=sys.stderr)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

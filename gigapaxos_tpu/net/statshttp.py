@@ -78,6 +78,12 @@ def observability_routes(path: str, groups_fn: Optional[Callable] = None,
         ex = RequestInstrumenter.export_trace(tid)
         ex["breakdown"] = RequestInstrumenter.cluster_breakdown(tid, [ex])
         return _json_resp(ex)
+    if path == "/chaos" or path.startswith("/chaos/"):
+        # runtime control + state of the fault plane (chaos/faults.py);
+        # the original path (with query) is re-joined for the verbs
+        from gigapaxos_tpu.chaos.faults import ChaosPlane
+        return ChaosPlane.http_route(
+            path + (("?" + query) if query else ""))
     return None
 
 

@@ -34,6 +34,7 @@ from collections import deque
 from typing import Awaitable, Callable, Dict, Optional, Tuple
 
 from gigapaxos_tpu import native
+from gigapaxos_tpu.chaos.faults import ChaosPlane
 from gigapaxos_tpu.utils.logutil import get_logger
 from gigapaxos_tpu.utils.profiler import DelayProfiler
 
@@ -135,6 +136,7 @@ class Transport:
         self.drop_peer_gone = 0    # no/closing connection to the dest
         self.drop_write_error = 0  # mid-write connection failure
         self.drop_test = 0         # test_drop_rate fault injection
+        self.drop_chaos = 0        # chaos-plane injected loss/partition
         self.reconnects = 0        # reconnect attempts after 1st connect
         self.connect_failures = 0  # connect attempts that failed
         # per-peer RTT from the failure-detector ping/pong (the cluster
@@ -202,6 +204,11 @@ class Transport:
             self.drop_peer_gone += nframes
         elif cause == "write_error":
             self.drop_write_error += nframes
+        elif cause == "chaos":
+            # injected by the fault plane: its own bucket so chaos runs
+            # never masquerade as backpressure or flaky links in the
+            # metrics plane (PR 2's per-cause split stays honest)
+            self.drop_chaos += nframes
         else:
             self.drop_test += nframes
 
@@ -214,6 +221,34 @@ class Transport:
             if self._drop_rng.random() < self.test_drop_rate:
                 self._drop(nframes, "test")
                 return False
+        # chaos fault plane (peer links only — client replies ride
+        # clean so scenario ack bookkeeping measures the cluster).
+        # Disabled costs ONE class-attribute check, the tracing-plane
+        # short-circuit discipline.
+        if ChaosPlane.enabled and dst in self.addr_map:
+            drop, delay = ChaosPlane.on_send(self.id, dst, nframes)
+            if drop:
+                self._drop(nframes, "chaos")
+                return False
+            if delay > 0.0:
+                # release through the event loop after the injected
+                # latency: the frame is genuinely late on the wire,
+                # and longer-delayed frames are genuinely overtaken
+                self._loop.call_later(delay, self._chaos_release, dst,
+                                      payload, preframed, nframes)
+                return True
+        return self._enqueue_now(dst, payload, preframed, nframes)
+
+    def _chaos_release(self, dst: int, payload: bytes, preframed: bool,
+                       nframes: int) -> None:
+        """A chaos-delayed frame reaches the real send path (skipping
+        the chaos gate — its verdict was already served)."""
+        if self._closed:
+            return
+        self._enqueue_now(dst, payload, preframed, nframes)
+
+    def _enqueue_now(self, dst: int, payload: bytes, preframed: bool,
+                     nframes: int) -> bool:
         if dst in self.addr_map:
             peer = self._peers.get(dst)
             if peer is None:
@@ -442,6 +477,12 @@ class Transport:
                     await asyncio.sleep(0.05)
                 if self._closed:
                     return
+                if ChaosPlane.enabled and \
+                        ChaosPlane.is_blocked(self.id, dst):
+                    # a partition starves bulk checkpoint transfers
+                    # too; the higher level re-requests after heal
+                    self._drop(1, "chaos")
+                    continue
                 w = peer.writer
                 try:
                     self._write(w, f, False, 1)
@@ -497,6 +538,7 @@ class Transport:
                 "peer_gone": self.drop_peer_gone,
                 "write_error": self.drop_write_error,
                 "test": self.drop_test,
+                "chaos": self.drop_chaos,
             },
             "reconnects": self.reconnects,
             "connect_failures": self.connect_failures,

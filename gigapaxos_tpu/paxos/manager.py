@@ -476,6 +476,11 @@ class PaxosNode:
         if trace_sample > 0:
             RequestInstrumenter.configure(sample_rate=trace_sample)
             RequestInstrumenter.enabled = True
+        # chaos fault plane (PC.CHAOS_*, all defaults off): only-enable
+        # like the tracing knobs — a plane configured programmatically
+        # (scenario runner, /chaos route) survives node constructions
+        from gigapaxos_tpu.chaos.faults import ChaosPlane
+        ChaosPlane.configure_from_pc()
         # failure detection (ref: gigapaxos/FailureDetection.java)
         self._last_heard: Dict[int, float] = {}
         self.ping_interval = float(Config.get(PC.PING_INTERVAL_S))

@@ -152,3 +152,25 @@ class PC(ConfigKey):
     # per-node stats listeners to scrape, as "id=host:port,id=host:
     # port".  Empty = the gateway serves only its local process view.
     STATS_PEERS = ""
+    # chaos fault plane (gigapaxos_tpu/chaos/): deterministic fault
+    # injection on the transport's PEER links — WAN emulation and
+    # partition drills per arXiv:1404.6719's cloud pathologies.  ALL
+    # defaults off; disabled costs the send path one attribute check.
+    # Runtime control: GET /chaos[...] on the stats listener.  The
+    # seed drives per-(src,dst)-pair PRNGs, so the k-th frame on a
+    # pair meets the same fate every run — a failing chaos run
+    # replays exactly (see chaos/faults.py).
+    CHAOS_SEED = 0
+    # base one-way delay + uniform jitter injected on every peer link
+    # (a specific link: /chaos/set?src=..&dst=..)
+    CHAOS_DELAY_MS = 0.0
+    CHAOS_JITTER_MS = 0.0
+    # probabilistic frame loss on peer links (0..1); counted under the
+    # transport's distinct "chaos" drop cause
+    CHAOS_DROP = 0.0
+    # probability a frame is held one extra beat so later frames
+    # overtake it (netem-style reorder; 0..1)
+    CHAOS_REORDER = 0.0
+    # boot-time partition spec "0,1|2": block both directions of every
+    # edge crossing the sets (asymmetric edges: /chaos/block)
+    CHAOS_PARTITION = ""

@@ -129,6 +129,30 @@ def main(argv=None) -> int:
                         '/cluster/* routes: "id=host:port,..." of every '
                         "node's stats listener (or STATS_PEERS= in the "
                         "properties file)")
+    p.add_argument("--chaos-seed", type=int, default=None,
+                   help="chaos fault plane PRNG seed (deterministic "
+                        "per-peer-pair fault schedules — a failing run "
+                        "replays exactly; or CHAOS_SEED= in the "
+                        "properties file; runtime control via GET "
+                        "/chaos on the stats listener)")
+    p.add_argument("--chaos-delay-ms", type=float, default=None,
+                   help="inject this one-way delay on every peer link "
+                        "(WAN emulation; or CHAOS_DELAY_MS=)")
+    p.add_argument("--chaos-jitter-ms", type=float, default=None,
+                   help="uniform jitter on top of --chaos-delay-ms "
+                        "(or CHAOS_JITTER_MS=)")
+    p.add_argument("--chaos-drop", type=float, default=None,
+                   help="probabilistic peer-frame loss 0..1, counted "
+                        "under the distinct 'chaos' drop cause "
+                        "(or CHAOS_DROP=)")
+    p.add_argument("--chaos-reorder", type=float, default=None,
+                   help="probability 0..1 a peer frame is held one "
+                        "beat so later frames overtake it "
+                        "(or CHAOS_REORDER=)")
+    p.add_argument("--chaos-partition", default=None,
+                   help='boot-time partition spec "0,1|2": block both '
+                        "directions across the sets (or "
+                        "CHAOS_PARTITION=; heal via GET /chaos/heal)")
     args = p.parse_args(argv)
 
     extras = read_extras(args.config)
@@ -186,6 +210,19 @@ def main(argv=None) -> int:
         else extras.get("STATS_PEERS")
     if stats_peers is not None:
         Config.set(PC.STATS_PEERS, stats_peers)
+    # chaos fault plane knobs (defaults off; the node mirrors them into
+    # ChaosPlane at boot — see chaos/faults.py)
+    for flag, key, conv in (
+            (args.chaos_seed, PC.CHAOS_SEED, int),
+            (args.chaos_delay_ms, PC.CHAOS_DELAY_MS, float),
+            (args.chaos_jitter_ms, PC.CHAOS_JITTER_MS, float),
+            (args.chaos_drop, PC.CHAOS_DROP, float),
+            (args.chaos_reorder, PC.CHAOS_REORDER, float),
+            (args.chaos_partition, PC.CHAOS_PARTITION, str)):
+        val = flag if flag is not None \
+            else (conv(extras[key.name]) if key.name in extras else None)
+        if val is not None:
+            Config.set(key, val)
 
     if args.paxos_only:
         # PaxosServer-style deployment: the engine without the control

@@ -9,6 +9,7 @@ emulation over REAL loopback sockets — no transport fakes, SURVEY.md
 from __future__ import annotations
 
 import asyncio
+import os
 import socket
 import time
 from typing import Dict, List, Optional, Tuple, Type
@@ -20,6 +21,19 @@ from gigapaxos_tpu.paxos.interfaces import NoopApp, Replicable
 from gigapaxos_tpu.paxos.manager import PaxosNode
 from gigapaxos_tpu.paxos.paxosconfig import PC
 from gigapaxos_tpu.utils.config import Config
+
+
+# Deadline scaling for slow hosts: generous default on 1-2 core boxes
+# (a neighboring JIT compile can starve a node for seconds); set
+# GP_TEST_TIMEOUT_SCALE=1 on beefy machines for speed.  THE one copy of
+# the policy — tests/conftest.py and the chaos scenario runner share it.
+_TSCALE = float(os.environ.get(
+    "GP_TEST_TIMEOUT_SCALE", "3" if (os.cpu_count() or 1) <= 2 else "1"))
+
+
+def tscale(t: float) -> float:
+    """Scale a deadline by the slow-host environment factor."""
+    return t * _TSCALE
 
 
 def free_ports(n: int) -> List[int]:

@@ -19,6 +19,7 @@ stale table fails the suite instead of shipping.
 """
 
 import argparse
+import glob
 import json
 import os
 
@@ -299,9 +300,57 @@ def render() -> str:
             " — the window knob, not the engine, sets the single-group "
             "ceiling |")
 
+    out.extend(_chaos_rows())
+
     out.append("")
     out.append(END)
     return "\n".join(out)
+
+
+def _chaos_rows():
+    """Robustness rows from the newest tracked ``CHAOS_*.json``
+    (`python -m gigapaxos_tpu.chaos --out ...`): one row per scenario —
+    faults injected, invariants held, recovery seconds.  Robustness
+    regressions become visible the same way perf ones are."""
+    files = sorted(glob.glob(os.path.join(HERE, "CHAOS_*.json")))
+    if not files:
+        return []
+    name = os.path.basename(files[-1])
+    art = _load(name)
+    if not art or not art.get("rows"):
+        return []
+    out = []
+    for r in art["rows"]:
+        if "error" in r:  # the scenario never completed (error row)
+            out.append(
+                f"| Chaos scenario `{r.get('scenario')}` (seed "
+                f"{r.get('seed')}, `{name}`) | **DID NOT COMPLETE: "
+                f"{r['error']}** |")
+            continue
+        invs = r.get("invariants", {})
+        held = sum(bool(v) for v in invs.values())
+        verdict = "**all invariants held**" if r.get("ok") else (
+            "**VIOLATED: "
+            + ", ".join(k for k, v in sorted(invs.items()) if not v)
+            + "**")
+        f = r.get("faults", {})
+        parts = [f"{f[k]} {lbl}" for k, lbl in (
+            ("blocked", "partition-blocked"), ("dropped", "dropped"),
+            ("delayed", "delayed"), ("reordered", "reordered"))
+            if f.get(k)]
+        crashes = sum("crash" in s.get("event", "") or
+                      "restart" in s.get("event", "")
+                      for s in r.get("stages", []))
+        if crashes:
+            parts.append(f"{crashes} crash/restart stage(s)")
+        out.append(
+            f"| Chaos scenario `{r.get('scenario')}` (seed "
+            f"{r.get('seed')}, {r.get('backend')} engine, `{name}`) | "
+            f"{verdict} ({held}/{len(invs)}); faults: "
+            f"{'; '.join(parts) if parts else 'none'}; recovery "
+            f"{r.get('recovery_s')} s; {r.get('acked')} acked ops, "
+            f"{r.get('client_errors')} client timeouts |")
+    return out
 
 
 def main() -> int:

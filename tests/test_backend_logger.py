@@ -241,6 +241,55 @@ def test_segmented_wal_cross_segment_replay_order(tmp_path):
     lg2.close()
 
 
+def test_torn_tail_with_shard_change_recovery(tmp_path):
+    """Chaos-restart dependency (PR 6): a node that crashed mid-write
+    under ENGINE_SHARDS=4 restarts as an S=2 node.  Recovery must read
+    ALL wal-<k>.log segments on disk — including 2 and 3, which are
+    beyond the new layout — drop ONLY the torn record on the old
+    segment 2, and preserve per-group record order.  This is the path
+    the shard_storm scenario leans on."""
+    import os
+    import struct
+
+    d = str(tmp_path / "schg")
+    lg = PaxosLogger(d, segments=4)
+    # two slots per group, one group per old segment
+    for slot in range(2):
+        for seg in range(4):
+            gkey = 40 + seg
+            lg.log_batch([LogEntry(REC_ACCEPT, gkey, slot, 1,
+                                   1000 * gkey + slot, b"pp")],
+                         seg=seg).result(5)
+    lg.close()
+    # tear OLD segment 2's tail: a header promising bytes that never
+    # hit the disk (pre-fsync crash), exactly what a chaos crash-stop
+    # leaves behind
+    rec = struct.Struct("<BQiiQI")
+    with open(os.path.join(d, "wal-2.log"), "ab") as f:
+        f.write(rec.pack(REC_ACCEPT, 42, 9, 1, 777, 128) + b"x")
+
+    lg2 = PaxosLogger(d, segments=2)  # the node came back with S=2
+    per_group = {}
+    for e in lg2.read_wal():
+        per_group.setdefault(e.gkey, []).append((e.slot, e.req_id))
+    # every complete record from every old segment replays, in order
+    for seg in range(4):
+        gkey = 40 + seg
+        assert per_group.get(gkey) == [
+            (0, 1000 * gkey), (1, 1000 * gkey + 1)], \
+            (gkey, per_group.get(gkey))
+    # the torn record is gone, silently
+    assert all(req != 777 for recs in per_group.values()
+               for _s, req in recs)
+    # new writes land in the S=2 layout; old segments are readable
+    # until compaction GCs them (logger._stale_segs covers 2 and 3)
+    lg2.log_batch([LogEntry(REC_ACCEPT, 40, 2, 1, 40002)],
+                  seg=0).result(5)
+    got = [(e.gkey, e.slot) for e in lg2.read_wal() if e.gkey == 40]
+    assert got == [(40, 0), (40, 1), (40, 2)]
+    lg2.close()
+
+
 def test_segmented_wal_compaction_isolated(tmp_path):
     """Compacting one segment GCs only its own below-checkpoint
     entries; sibling segments' bytes are untouched."""

@@ -1,0 +1,335 @@
+"""Chaos plane (PR 6): deterministic fault injection, distinct drop
+cause accounting, /chaos runtime control, the invariant checker's
+teeth, and the scenario suite (full timelines are ``slow``; the 2-node
+partition-heal mini-scenario rides the ``smoke`` gate)."""
+
+import asyncio
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from gigapaxos_tpu.chaos.faults import (ChaosPlane, parse_partition_spec)
+from gigapaxos_tpu.chaos import invariants as inv
+from gigapaxos_tpu.net.transport import Transport
+from gigapaxos_tpu.paxos import packets as pk
+
+from tests.conftest import tscale
+
+
+# --------------------------------------------------------------------------
+# fault plane unit behavior
+# --------------------------------------------------------------------------
+
+
+@pytest.mark.smoke
+def test_schedule_is_deterministic_per_seed():
+    """Same seed + rules -> the k-th frame on a pair meets the same
+    fate (drop/delay sequence identical); a different seed diverges.
+    This is the replay contract the scenario rows fingerprint."""
+    def decisions(seed, n=200):
+        ChaosPlane.reset()
+        ChaosPlane.configure(seed=seed)
+        ChaosPlane.set_link(None, None, delay_s=0.001, jitter_s=0.004,
+                            drop_p=0.25, reorder_p=0.15)
+        out = [ChaosPlane.on_send(0, 1, 1) for _ in range(n)]
+        fp = ChaosPlane.schedule_fingerprint([(0, 1), (1, 0)])
+        ChaosPlane.reset()
+        return out, fp
+
+    a, fp_a = decisions(7)
+    b, fp_b = decisions(7)
+    c, fp_c = decisions(8)
+    assert a == b and fp_a == fp_b
+    assert a != c and fp_a != fp_c
+    # the stream actually exercises every fault kind at these rates
+    assert any(drop for drop, _ in a)
+    assert any(not drop and d > 0 for drop, d in a)
+
+
+@pytest.mark.smoke
+def test_pair_streams_independent():
+    """Per-pair PRNGs: consuming one link's stream must not perturb
+    another's (a pair's schedule replays regardless of what other
+    links carried)."""
+    ChaosPlane.reset()
+    ChaosPlane.configure(seed=3)
+    ChaosPlane.set_link(None, None, drop_p=0.5)
+    alone = [ChaosPlane.on_send(0, 1, 1) for _ in range(64)]
+    ChaosPlane.clear()
+    ChaosPlane.configure(seed=3)
+    ChaosPlane.set_link(None, None, drop_p=0.5)
+    interleaved = []
+    for _ in range(64):
+        ChaosPlane.on_send(2, 0, 1)  # traffic on other pairs
+        interleaved.append(ChaosPlane.on_send(0, 1, 1))
+        ChaosPlane.on_send(1, 2, 1)
+    ChaosPlane.reset()
+    assert alone == interleaved
+
+
+@pytest.mark.smoke
+def test_partition_spec_and_rule_precedence():
+    assert parse_partition_spec("0,1|2") == [{0, 1}, {2}]
+    assert parse_partition_spec("") == []
+    ChaosPlane.reset()
+    # clearing a (nonexistent) rule must NOT arm the plane: an idle
+    # plane stays one short-circuited attribute check on the hot path
+    ChaosPlane.set_link(0, 1)
+    assert not ChaosPlane.enabled
+    ChaosPlane.set_link(None, None, drop_p=1.0)
+    assert ChaosPlane.enabled
+    ChaosPlane.set_link(0, 1, delay_s=0.01)  # exact beats wildcard
+    drop, delay = ChaosPlane.on_send(0, 1, 1)
+    assert not drop and delay == pytest.approx(0.01)
+    drop, _ = ChaosPlane.on_send(0, 2, 1)  # wildcard still applies
+    assert drop
+    ChaosPlane.reset()
+
+
+@pytest.mark.smoke
+def test_transport_chaos_drop_cause_accounting():
+    """Satellite: injected drops count under the DISTINCT ``chaos``
+    cause — never congestion/write_error/test — so PR 2's per-cause
+    split stays honest under fault injection; and a partition blocks
+    only its direction (asymmetric)."""
+    async def main():
+        in0, in1 = [], []
+        t0 = Transport(0, ("127.0.0.1", 0), {},
+                       on_frame=lambda f: in0.append(pk.decode(f)))
+        await t0.start()
+        t1 = Transport(1, ("127.0.0.1", 0),
+                       {0: ("127.0.0.1", t0.port)},
+                       on_frame=lambda f: in1.append(pk.decode(f)))
+        await t1.start()
+        t0.addr_map[1] = ("127.0.0.1", t1.port)
+
+        async def wait(cond, timeout=5.0):
+            t = asyncio.get_event_loop().time()
+            while not cond():
+                assert asyncio.get_event_loop().time() - t < timeout
+                await asyncio.sleep(0.005)
+
+        for k in range(5):
+            assert t1.send(0, pk.Prepare(1, k, k).encode())
+        await wait(lambda: len(in0) == 5)
+
+        ChaosPlane.block(1, 0)  # asymmetric: 1->0 dark, 0->1 flows
+        for k in range(7):
+            assert not t1.send(0, pk.Prepare(1, k, k).encode())
+        assert t1.drop_chaos == 7 and t1.dropped_frames == 7
+        assert t1.drop_congestion == 0 and t1.drop_test == 0
+        assert t1.drop_write_error == 0 and t1.drop_peer_gone == 0
+        m = t1.metrics()
+        assert m["drops"]["chaos"] == 7
+        assert t0.send(1, pk.FailureDetect(0, 0, 9).encode())
+        await wait(lambda: len(in1) == 1)
+        assert t0.drop_chaos == 0
+
+        ChaosPlane.heal()
+        assert t1.send(0, pk.Prepare(1, 99, 99).encode())
+        await wait(lambda: len(in0) == 6)
+        await t1.stop()
+        await t0.stop()
+
+    ChaosPlane.reset()
+    try:
+        asyncio.run(main())
+    finally:
+        ChaosPlane.reset()
+
+
+@pytest.mark.smoke
+def test_chaos_delay_releases_late_and_reorders():
+    """Delayed frames arrive after the injected latency; a longer-held
+    frame is overtaken by one sent later (reorder by delay)."""
+    async def main():
+        import time
+        in0 = []
+        t0 = Transport(0, ("127.0.0.1", 0), {},
+                       on_frame=lambda f: in0.append(pk.decode(f)))
+        await t0.start()
+        t1 = Transport(1, ("127.0.0.1", 0),
+                       {0: ("127.0.0.1", t0.port)},
+                       on_frame=lambda f: None)
+        await t1.start()
+        ChaosPlane.set_link(1, 0, delay_s=0.08)
+        ts = time.monotonic()
+        assert t1.send(0, pk.Prepare(1, 1, 1).encode())
+        ChaosPlane.set_link(1, 0)  # clear the rule: next frame direct
+        assert t1.send(0, pk.Prepare(1, 2, 2).encode())
+        while len(in0) < 2:
+            await asyncio.sleep(0.005)
+        assert time.monotonic() - ts >= 0.07
+        # the un-delayed frame (gkey 2) overtook the held one (gkey 1)
+        assert [p.gkey for p in in0] == [2, 1]
+        assert ChaosPlane.n_delayed == 1
+        await t1.stop()
+        await t0.stop()
+
+    ChaosPlane.reset()
+    ChaosPlane.configure(seed=1, enabled=True)
+    try:
+        asyncio.run(main())
+    finally:
+        ChaosPlane.reset()
+
+
+# --------------------------------------------------------------------------
+# /chaos runtime control on the stats listener
+# --------------------------------------------------------------------------
+
+
+@pytest.mark.smoke
+def test_chaos_http_route(tmp_path):
+    """GET /chaos on the per-node stats listener: snapshot, set,
+    partition, heal, clear — runtime control without redeploy."""
+    from gigapaxos_tpu.paxos.interfaces import NoopApp
+    from gigapaxos_tpu.paxos.manager import PaxosNode
+    from gigapaxos_tpu.paxos.paxosconfig import PC
+    from gigapaxos_tpu.testing.harness import free_ports
+    from gigapaxos_tpu.utils.config import Config
+
+    Config.set(PC.STATS_PORT, 0)
+    addr = {0: ("127.0.0.1", free_ports(1)[0])}
+    node = PaxosNode(0, addr, NoopApp(), str(tmp_path),
+                     backend="native")
+    node.start()
+    try:
+        port = node.stats_http.port
+
+        def get(path):
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}{path}",
+                    timeout=tscale(5)) as r:
+                return r.status, json.loads(r.read())
+
+        st, d = get("/chaos")
+        assert st == 200 and d["enabled"] is False and d["rules"] == {}
+
+        st, d = get("/chaos/set?src=0&dst=1&delay_ms=5&jitter_ms=2"
+                    "&drop=0.1&reorder=0.05")
+        assert st == 200 and d["enabled"] is True
+        assert d["rules"]["0->1"] == {"delay_ms": 5.0, "jitter_ms": 2.0,
+                                      "drop": 0.1, "reorder": 0.05}
+        st, d = get("/chaos/partition?sets=0,1|2")
+        assert sorted(d["blocked"]) == ["0->2", "1->2", "2->0", "2->1"]
+        st, d = get("/chaos/block?src=3&dst=0")
+        assert "3->0" in d["blocked"]
+        st, d = get("/chaos/seed?v=99")
+        assert d["seed"] == 99
+        st, d = get("/chaos/heal")
+        assert d["blocked"] == [] and d["rules"]  # rules survive heal
+        st, d = get("/chaos/clear")
+        assert d["rules"] == {} and d["enabled"] is False
+        # bad requests answer 400/404, not 500
+        try:
+            get("/chaos/partition?sets=")
+            assert False, "expected 400"
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+        try:
+            get("/chaos/frobnicate")
+            assert False, "expected 404"
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+    finally:
+        node.stop()
+        ChaosPlane.reset()
+
+
+# --------------------------------------------------------------------------
+# the invariant checker must have teeth
+# --------------------------------------------------------------------------
+
+
+@pytest.mark.smoke
+def test_invariant_checker_catches_violations():
+    """A checker that cannot fail proves nothing: forged broken
+    histories must be rejected, clean ones accepted."""
+    # duplicate linearization position
+    assert inv.check_single_order(
+        [(0.0, 1.0, 1, 5), (2.0, 3.0, 2, 5)])
+    # real-time inversion
+    assert inv.check_single_order(
+        [(0.0, 1.0, 1, 9), (2.0, 3.0, 2, 4)])
+    # clean overlapping history
+    assert not inv.check_single_order(
+        [(0.0, 2.0, 1, 2), (1.0, 3.0, 2, 1), (2.5, 4.0, 3, 3)])
+    # a lost ack: node 1 converged below the highest acked position
+    hist = {"g": [(0.0, 1.0, 10, 3)]}
+    assert inv.no_lost_acks(hist, {0: {"g": 3}, 1: {"g": 2}})
+    assert not inv.no_lost_acks(hist, {0: {"g": 3}, 1: {"g": 3}})
+    # rotated membership: a node that does not HOST the group is not a
+    # lost ack — but a lagging member still is
+    assert not inv.no_lost_acks(hist, {0: {"g": 3}, 1: {}},
+                                members={"g": (0,)})
+    assert inv.no_lost_acks(hist, {0: {"g": 3}, 1: {"g": 1}},
+                            members={"g": (0, 1)})
+    # digest divergence across replicas
+    assert inv.digests_converged({0: {"g": 1}, 1: {"g": 2}})
+    assert not inv.digests_converged({0: {"g": 1}, 1: {"g": 1}})
+
+
+# --------------------------------------------------------------------------
+# scenarios
+# --------------------------------------------------------------------------
+
+
+@pytest.mark.smoke
+def test_mini_partition_heal_scenario(tmp_path):
+    """The quick-gate scenario: a 2-node full partition stalls the
+    quorum (faults bite), heal restores service, every invariant holds
+    — the scenario runner proven end to end in under 20s."""
+    from gigapaxos_tpu.chaos.scenarios import run_scenario
+    row = run_scenario("mini_partition_heal", seed=1,
+                       workdir=str(tmp_path))
+    assert row["ok"], row.get("violations")
+    assert row["invariants"] == {
+        "no_lost_acks": True, "digest_linearizable": True,
+        "cursors_converged": True, "churn_steady": True}
+    assert row["faults"]["blocked"] > 0  # the partition really bit
+    assert row["acked"] > 0
+    assert row["schedule_fingerprint"] != "0" * 16
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("name", ["partition_heal", "leader_crash",
+                                  "rolling_restart", "shard_storm"])
+def test_full_scenario(tmp_path, name):
+    """The full drill (tier-1 excluded; run with -m slow or via
+    ``python -m gigapaxos_tpu.chaos``): staged faults under load, all
+    invariants hold, and the injected-fault counters prove the faults
+    actually fired."""
+    from gigapaxos_tpu.chaos.scenarios import run_scenario
+    row = run_scenario(name, seed=1, workdir=str(tmp_path))
+    assert row["ok"], (name, row.get("violations"))
+    assert row["acked"] > 0
+    total_injected = (row["faults"]["blocked"] + row["faults"]["dropped"]
+                      + row["faults"]["delayed"])
+    if name != "leader_crash":  # its fault is the crash, not the links
+        assert total_injected > 0, row["faults"]
+    assert any("crash" in s["event"] or "partition" in s["event"]
+               or "loss" in s["event"] for s in row["stages"])
+    if name == "shard_storm":
+        assert row["engine_shards_timeline"] == [2, 1, 2]
+
+
+@pytest.mark.slow
+def test_scenario_replays_identically(tmp_path):
+    """Acceptance: the same seed produces the IDENTICAL fault
+    schedule (fingerprint + staged event sequence); a different seed
+    produces a different schedule."""
+    from gigapaxos_tpu.chaos.scenarios import run_scenario
+    a = run_scenario("partition_heal", seed=5,
+                     workdir=str(tmp_path / "a"))
+    b = run_scenario("partition_heal", seed=5,
+                     workdir=str(tmp_path / "b"))
+    c = run_scenario("partition_heal", seed=6,
+                     workdir=str(tmp_path / "c"))
+    assert a["schedule_fingerprint"] == b["schedule_fingerprint"]
+    assert [s["event"] for s in a["stages"]] == \
+        [s["event"] for s in b["stages"]]
+    assert a["schedule_fingerprint"] != c["schedule_fingerprint"]
+    assert a["ok"] and b["ok"] and c["ok"]

@@ -73,3 +73,48 @@ def test_lane_balance_idle_shard_renders_idle(tmp_path, monkeypatch):
     lane_row = next(ln for ln in out.splitlines()
                     if "Engine-lane balance" in ln)
     assert "max/min skew 4.00x" in lane_row and "idle" not in lane_row
+
+
+def test_chaos_rows_render(tmp_path, monkeypatch):
+    """Satellite (PR 6): the newest CHAOS_*.json renders one row per
+    scenario — scenario, faults injected, invariants held, recovery
+    seconds — and a violated invariant is named, not averaged away."""
+    import json
+    rows = [{
+        "scenario": "partition_heal", "seed": 1, "backend": "native",
+        "ok": True, "recovery_s": 5.27, "acked": 96,
+        "client_errors": 0,
+        "invariants": {"no_lost_acks": True,
+                       "digest_linearizable": True,
+                       "cursors_converged": True, "churn_steady": True},
+        "faults": {"blocked": 120, "dropped": 0, "delayed": 240,
+                   "reordered": 3},
+        "stages": [{"t_s": 1.0, "event": "partition {0,1} | {2}"},
+                   {"t_s": 4.0, "event": "heal partition"}],
+    }, {
+        "scenario": "leader_crash", "seed": 1, "backend": "native",
+        "ok": False, "recovery_s": 9.0, "acked": 10,
+        "client_errors": 4,
+        "invariants": {"no_lost_acks": False,
+                       "digest_linearizable": True,
+                       "cursors_converged": True, "churn_steady": True},
+        "faults": {"blocked": 0, "dropped": 0, "delayed": 0,
+                   "reordered": 0},
+        "stages": [{"t_s": 1.0, "event": "crash-stop node 2"},
+                   {"t_s": 3.0, "event": "restart node 2"}],
+    }]
+    for fn in ("CHAOS_r00.json", "CHAOS_r01.json"):  # newest wins
+        with open(os.path.join(tmp_path, fn), "w") as f:
+            json.dump({"seed": 1, "rows": rows if fn.endswith("01.json")
+                       else []}, f)
+    monkeypatch.setattr(render_perf, "HERE", str(tmp_path))
+    out = render_perf.render()
+    ph = next(ln for ln in out.splitlines()
+              if "`partition_heal`" in ln)
+    assert "all invariants held" in ph and "(4/4)" in ph
+    assert "120 partition-blocked" in ph and "240 delayed" in ph
+    assert "recovery 5.27 s" in ph and "96 acked ops" in ph
+    assert "CHAOS_r01.json" in ph
+    lc = next(ln for ln in out.splitlines() if "`leader_crash`" in ln)
+    assert "VIOLATED: no_lost_acks" in lc and "(3/4)" in lc
+    assert "2 crash/restart stage(s)" in lc
