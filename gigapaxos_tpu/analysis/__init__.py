@@ -1,0 +1,22 @@
+"""Project-native static analysis (``python -m gigapaxos_tpu.analysis``).
+
+Seven AST rules encoding this repo's concurrency and hot-path
+invariants — see ``decls.py`` for the registry, ADVICE.md for the
+postmortems behind each rule, and README "Static analysis" for usage
+(baselining, adding rules).  Pure stdlib ``ast``; never imports the
+code under analysis.
+"""
+
+from gigapaxos_tpu.analysis.core import (BaselineError, Context,
+                                         Finding, all_rules, analyze,
+                                         build_context, load_baseline,
+                                         split_baselined)
+from gigapaxos_tpu.analysis.decls import (Decls, HotPath,
+                                          ThreadedClass,
+                                          project_decls)
+
+__all__ = [
+    "BaselineError", "Context", "Decls", "Finding", "HotPath",
+    "ThreadedClass", "all_rules", "analyze", "build_context",
+    "load_baseline", "project_decls", "split_baselined",
+]

@@ -1,0 +1,70 @@
+"""CLI: ``python -m gigapaxos_tpu.analysis [--baseline F] [--out F]``.
+
+Exit 0 when every finding is covered by the baseline, 1 otherwise
+(new findings are listed; so are stale baseline entries, which don't
+fail the run but should be pruned).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+from pathlib import Path
+
+from gigapaxos_tpu.analysis import core, decls
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m gigapaxos_tpu.analysis",
+        description="project-native static analysis suite")
+    ap.add_argument("--root", default=None,
+                    help="repo root (default: auto-detect from the "
+                         "package location)")
+    ap.add_argument("--baseline", default=None,
+                    help="baseline JSON (default: "
+                         "<root>/ANALYSIS_BASELINE.json if present)")
+    ap.add_argument("--out", default=None,
+                    help="write the JSON artifact here "
+                         "(e.g. ANALYSIS_r01.json)")
+    ap.add_argument("--rules", default=None,
+                    help="comma-separated subset of rule ids")
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for name in sorted(core.all_rules()):
+            print(name)
+        return 0
+
+    root = Path(args.root) if args.root else \
+        Path(__file__).resolve().parents[2]
+    t0 = time.monotonic()
+    ctx = core.build_context(root, decls.project_decls())
+    rules = args.rules.split(",") if args.rules else None
+    findings = core.analyze(ctx, rules)
+
+    baseline = {}
+    bl_path = Path(args.baseline) if args.baseline else \
+        root / "ANALYSIS_BASELINE.json"
+    if bl_path.is_file():
+        baseline = core.load_baseline(bl_path)
+    new, old, stale = core.split_baselined(findings, baseline)
+
+    nfiles = len(ctx.files)
+    print(core.report(new, old, stale, nfiles))
+    dt = time.monotonic() - t0
+    print(f"({dt:.2f}s)")
+
+    if args.out:
+        import json
+        payload = core.to_json(new, old, stale, nfiles)
+        payload["elapsed_s"] = round(dt, 3)
+        Path(args.out).write_text(json.dumps(payload, indent=2)
+                                  + "\n")
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

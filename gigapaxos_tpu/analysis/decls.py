@@ -1,0 +1,215 @@
+"""Declared concurrency / hot-path / knob registry the rules read.
+
+This file IS the project's concurrency contract, written down.  The
+threading model (see README "Scaling out a node"): an asyncio event
+loop thread, an optional decode-split intake thread, and S per-lane
+proc/emit worker threads.  Anything two of those touch must be listed
+here with the lock that guards it — the ``race`` rule then enforces
+the contract mechanically, and NEW shared state that isn't declared
+simply isn't checked, so declare it when you add it (MIGRATING has
+the convention).
+
+Deliberately NOT declared (single-writer by design, reads may tear
+benignly): Transport's tx/rx/drop counters (event-loop-owned),
+``PaxosNode._intake_tokens`` (decode-thread-owned),
+``PaxosNode._stall_streak`` (lane-0 tick only), the singletons'
+``enabled`` gates where only the boot path writes them, and
+``RequestInstrumenter._last_evict``'s *readers* (the unlocked
+throttle read is the point; the write still goes under the lock).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, FrozenSet, Optional, Tuple
+
+
+@dataclass(frozen=True)
+class ThreadedClass:
+    """One class whose instances are touched by >1 thread.
+
+    ``locks``: attribute names that hold ``threading.Lock``-likes.
+    ``rlocks``: subset that are reentrant (nesting self is legal).
+    ``guarded``: attr -> lock attr; every *mutation* of the attr must
+    happen lexically inside ``with self.<lock>`` (``__init__`` and
+    ``__new__`` excluded — no second thread exists yet).
+    """
+
+    locks: FrozenSet[str]
+    rlocks: FrozenSet[str] = frozenset()
+    guarded: Dict[str, str] = field(default_factory=dict)
+    # methods exempt from the race rule (documented single-threaded
+    # entry points, e.g. test-harness hooks) — use sparingly
+    exempt_methods: FrozenSet[str] = frozenset()
+
+
+@dataclass(frozen=True)
+class HotPath:
+    """One registered hot path (``"Class.method"`` key).
+
+    mode "gate_first": the method must test one of ``gates`` before
+    any allocation/formatting/logging work (the disabled cost is one
+    attribute check).  mode "lean": the whole body must stay free of
+    logging/formatting (allocation is its job, logging never is).
+    """
+
+    mode: str                      # "gate_first" | "lean"
+    gates: Tuple[str, ...] = ()    # attr names or dotted Class.attr
+
+
+@dataclass(frozen=True)
+class Decls:
+    threaded: Dict[str, ThreadedClass] = field(default_factory=dict)
+    hot_paths: Dict[str, HotPath] = field(default_factory=dict)
+    # canonical outer -> inner acquisition order; an observed edge
+    # contradicting this order is a deadlock seed
+    lock_order: Tuple[str, ...] = ()
+    # lock ids that must be innermost (no other declared lock may be
+    # acquired while holding one)
+    leaf_locks: FrozenSet[str] = frozenset()
+    # "Class.attr" of a *list* of locks -> helper methods that yield
+    # them in canonical index order; accumulating acquisition (e.g.
+    # ExitStack) must go through a helper or ``sorted(...)``
+    indexed_locks: Dict[str, Tuple[str, ...]] = field(
+        default_factory=dict)
+    # alias lock attr -> canonical lock id (e.g. _engine_lock is
+    # lane 0 of _engine_locks)
+    lock_aliases: Dict[str, str] = field(default_factory=dict)
+    # knob-family prefix -> call that must appear in tests/conftest.py
+    # (None = plain Config.clear() coverage is enough)
+    knob_families: Dict[str, Optional[str]] = field(default_factory=dict)
+    # config class name holding the knob enum ("PC")
+    knob_class: str = "PC"
+
+
+def project_decls() -> Decls:
+    """The registry for THIS repo's tree."""
+    threaded = {
+        # S lane workers + event loop + decode thread; cross-lane
+        # counters go through _stat_lock (a bare += loses updates)
+        "PaxosNode": ThreadedClass(
+            locks=frozenset({"_engine_locks", "_engine_lock",
+                             "_stat_lock"}),
+            rlocks=frozenset({"_engine_locks", "_engine_lock"}),
+            guarded={c: "_stat_lock" for c in (
+                "n_executed", "n_decided", "n_paused", "n_unpaused",
+                "n_redriven", "n_parked", "n_park_dropped",
+                "n_redrive_capped", "n_installs", "n_ballot_changes",
+                "n_shed")},
+        ),
+        # name/row registry: lane workers resolve while the loop
+        # creates/deletes
+        "GroupTable": ThreadedClass(
+            locks=frozenset({"_mut"}),
+            guarded={a: "_mut" for a in
+                     ("_by_key", "_by_row", "_free", "_msets",
+                      "_rows")},
+        ),
+        # note_rtt runs on worker threads, metrics() on the loop
+        "Transport": ThreadedClass(
+            locks=frozenset({"_rtt_lock"}),
+            guarded={"_rtt": "_rtt_lock"},
+        ),
+        # WAL segments have per-segment writer locks; the sqlite
+        # handle one db lock.  _wals is guarded because compaction
+        # swaps handles in place — writers must re-read the slot
+        # under the segment lock (the closed-handle race fixed
+        # alongside this suite)
+        "PaxosLogger": ThreadedClass(
+            locks=frozenset({"_wal_locks", "_db_lock"}),
+            guarded={"_wals": "_wal_locks"},
+        ),
+        # class-attribute singletons: every update hook may be hit
+        # from any stage thread
+        "DelayProfiler": ThreadedClass(
+            locks=frozenset({"_lock"}),
+            guarded={a: "_lock" for a in
+                     ("_delays", "_values", "_rates", "_totals",
+                      "_hists")},
+        ),
+        "RequestInstrumenter": ThreadedClass(
+            locks=frozenset({"_lock"}),
+            guarded={a: "_lock" for a in
+                     ("_ring", "_spans", "_open", "_slow",
+                      "n_span_begun", "n_span_ended",
+                      "n_span_orphaned", "_last_evict")},
+        ),
+        "ChaosPlane": ThreadedClass(
+            locks=frozenset({"_lock"}),
+            guarded={a: "_lock" for a in
+                     ("_rules", "_blocked", "_rngs", "_per_pair",
+                      "n_dropped", "n_blocked", "n_delayed",
+                      "n_reordered", "enabled", "seed")},
+        ),
+        "Config": ThreadedClass(
+            locks=frozenset({"_lock"}),
+            rlocks=frozenset({"_lock"}),
+            guarded={"_layers": "_lock"},
+        ),
+    }
+    hot_paths = {
+        # peer send entry: every frame crosses this
+        "Transport._enqueue": HotPath(
+            "gate_first", gates=("test_drop_rate",
+                                 "ChaosPlane.enabled")),
+        "Transport._enqueue_now": HotPath("lean"),
+        "Transport._write": HotPath("lean"),
+        "ChaosPlane.on_send": HotPath("lean"),
+        # per-request tracing hooks: one attribute check when off
+        "RequestInstrumenter.record": HotPath(
+            "gate_first", gates=("enabled",)),
+        "RequestInstrumenter.span_begin": HotPath(
+            "gate_first", gates=("enabled",)),
+        "RequestInstrumenter.note_done": HotPath(
+            "gate_first", gates=("enabled",)),
+        "RequestInstrumenter.sampled_mask": HotPath(
+            "gate_first", gates=("enabled",)),
+        # per-stage delay hooks
+        "DelayProfiler.update_delay": HotPath(
+            "gate_first", gates=("enabled",)),
+        "DelayProfiler.update_value": HotPath(
+            "gate_first", gates=("enabled",)),
+        "DelayProfiler.update_rate": HotPath(
+            "gate_first", gates=("enabled",)),
+        "DelayProfiler.update_total": HotPath(
+            "gate_first", gates=("enabled",)),
+        "DelayProfiler.add_total": HotPath(
+            "gate_first", gates=("enabled",)),
+        # columnar wave submits: allocation is their job, logging
+        # and f-strings are not
+        "ColumnarBackend.accept_submit": HotPath("lean"),
+        "ColumnarBackend.accept_reply_submit": HotPath("lean"),
+        "ColumnarBackend.commit_submit": HotPath("lean"),
+        # the wave's submit half IS the constructor
+        "EngineWave.__init__": HotPath("lean"),
+        "EngineWave.collect": HotPath("lean"),
+    }
+    return Decls(
+        threaded=threaded,
+        hot_paths=hot_paths,
+        # engine lane locks are outermost (they serialize the lane
+        # against control-plane ops), then the group table's mutation
+        # lock; stat/profiler/instrument/chaos locks are leaves
+        lock_order=("PaxosNode._engine_locks", "GroupTable._mut",
+                    "PaxosNode._stat_lock"),
+        leaf_locks=frozenset({
+            "PaxosNode._stat_lock", "Transport._rtt_lock",
+            "DelayProfiler._lock", "RequestInstrumenter._lock",
+            "ChaosPlane._lock", "Config._lock",
+        }),
+        indexed_locks={
+            "PaxosNode._engine_locks": ("_locks_for",),
+            "PaxosLogger._wal_locks": (),
+        },
+        lock_aliases={"PaxosNode._engine_lock":
+                      "PaxosNode._engine_locks"},
+        knob_families={
+            "CHAOS_": "ChaosPlane.reset",
+            "TRACE_": "RequestInstrumenter.reset",
+            "SLOW_TRACE_": "RequestInstrumenter.reset",
+            "PROFILE_": "DelayProfiler.clear",
+            # read at node boot into per-node state, torn down with
+            # the node; Config.clear() coverage is enough
+            "STATS_": None,
+        },
+    )

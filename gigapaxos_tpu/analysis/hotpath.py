@@ -1,0 +1,160 @@
+"""Rule: hot-path gate discipline (R5).
+
+Registered hot paths (``decls.hot_paths``) sit on the per-frame /
+per-request fast path.  Two contracts:
+
+* ``gate_first`` — the method's *disabled* cost must be one attribute
+  check: a statement referencing one of the declared gate attributes
+  must come before any allocation (non-empty dict/list/set displays,
+  comprehensions), string formatting (f-strings, ``.format``), or
+  logging/print work.  A registered path with no gate test at all is
+  its own finding (the gate was deleted or renamed).
+* ``lean`` — the whole body must stay free of logging, print, and
+  string formatting.  Building lists/dicts is the method's job;
+  narrating it is not.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set
+
+from gigapaxos_tpu.analysis.core import (Context, Finding, FUNC_NODES,
+                                         SourceFile)
+
+RULE = "hot-path"
+
+_LOG_RECEIVERS = {"log", "logger", "logging"}
+_LOG_METHODS = {"debug", "info", "warning", "warn", "error",
+                "exception", "critical", "log"}
+
+
+def _gate_tokens(gates) -> Set[str]:
+    """Gate specs are attr names ("enabled") or dotted
+    ("ChaosPlane.enabled"); match on the final attribute name plus
+    the full dotted form."""
+    out: Set[str] = set()
+    for g in gates:
+        out.add(g)
+        out.add(g.split(".")[-1])
+    return out
+
+
+def _refs_gate(node: ast.AST, tokens: Set[str]) -> bool:
+    for n in ast.walk(node):
+        if isinstance(n, ast.Attribute) and n.attr in tokens:
+            return True
+        if isinstance(n, ast.Name) and n.id in tokens:
+            return True
+    return False
+
+
+def _expensive(node: ast.AST) -> Optional[str]:
+    """Name the first expensive construct under ``node``, if any."""
+    for n in ast.walk(node):
+        if isinstance(n, ast.JoinedStr):
+            return "f-string"
+        if isinstance(n, (ast.ListComp, ast.SetComp, ast.DictComp,
+                          ast.GeneratorExp)):
+            return "comprehension"
+        if isinstance(n, ast.Dict) and n.keys:
+            return "dict construction"
+        if isinstance(n, (ast.List, ast.Set)) and n.elts:
+            return "list/set construction"
+        bad = _log_call(n)
+        if bad:
+            return bad
+    return None
+
+
+def _log_call(n: ast.AST) -> Optional[str]:
+    if not isinstance(n, ast.Call):
+        return None
+    f = n.func
+    if isinstance(f, ast.Name) and f.id == "print":
+        return "print()"
+    if isinstance(f, ast.Attribute):
+        if f.attr == "format" and not (
+                isinstance(f.value, ast.Name)
+                and f.value.id in ("struct",)):
+            return "str.format()"
+        recv = f.value
+        recv_name = recv.id if isinstance(recv, ast.Name) else (
+            recv.attr if isinstance(recv, ast.Attribute) else None)
+        if recv_name in _LOG_RECEIVERS and f.attr in _LOG_METHODS:
+            return f"logging call ({recv_name}.{f.attr})"
+    return None
+
+
+def _find_method(sf: SourceFile, cls_name: str, meth: str):
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.ClassDef) and node.name == cls_name:
+            for fn in node.body:
+                if isinstance(fn, FUNC_NODES) and fn.name == meth:
+                    return fn
+    return None
+
+
+def check(ctx: Context) -> List[Finding]:
+    findings: List[Finding] = []
+    seen: Set[str] = set()
+    for key, hp in sorted(ctx.decls.hot_paths.items()):
+        cls_name, meth = key.split(".", 1)
+        for sf in ctx.files:
+            fn = _find_method(sf, cls_name, meth)
+            if fn is None:
+                continue
+            seen.add(key)
+            if hp.mode == "lean":
+                _check_lean(sf, key, fn, findings)
+            else:
+                _check_gate_first(sf, key, hp, fn, findings)
+    for key in sorted(set(ctx.decls.hot_paths) - seen):
+        findings.append(Finding(
+            RULE, "gigapaxos_tpu/analysis/decls.py", 0, key,
+            f"registered hot path {key} not found in the tree — "
+            f"renamed or deleted without updating the registry",
+            key))
+    return findings
+
+
+def _check_lean(sf: SourceFile, key: str, fn,
+                findings: List[Finding]) -> None:
+    for n in ast.walk(fn):
+        what = _log_call(n)
+        if what is None and isinstance(n, ast.JoinedStr):
+            what = "f-string"
+        if what:
+            findings.append(Finding(
+                RULE, sf.rel, getattr(n, "lineno", fn.lineno), key,
+                f"{what} in lean hot path — this method runs "
+                f"per-frame; formatting/logging belongs on the "
+                f"caller's slow path", sf.snippet(n)))
+
+
+def _check_gate_first(sf: SourceFile, key: str, hp, fn,
+                      findings: List[Finding]) -> None:
+    tokens = _gate_tokens(hp.gates)
+    gate_seen = False
+    for st in fn.body:
+        if isinstance(st, ast.Expr) \
+                and isinstance(st.value, ast.Constant):
+            continue  # docstring
+        if _refs_gate(st, tokens):
+            gate_seen = True
+            break
+        what = _expensive(st)
+        if what:
+            findings.append(Finding(
+                RULE, sf.rel, st.lineno, key,
+                f"{what} before the disabled-gate check "
+                f"({'/'.join(hp.gates)}) — the disabled cost of a "
+                f"registered hot path must be one attribute check",
+                sf.snippet(st)))
+    if not gate_seen:
+        findings.append(Finding(
+            RULE, sf.rel, fn.lineno, key,
+            f"registered gate_first hot path never tests its "
+            f"disabled gate ({'/'.join(hp.gates)}) — gate deleted "
+            f"or renamed without updating analysis/decls.py",
+            sf.snippet(fn)))

@@ -1,0 +1,332 @@
+"""Rules: lazy-init hazard (R3) and parameter shadowing (R4).
+
+R3: ``getattr(self, "x", default)`` / ``hasattr(self, "x")`` fallbacks
+on attributes that ``__init__`` never eagerly assigns hide ordering
+bugs — the attribute silently reads as the default on the path that
+runs before whoever lazily sets it (the PR 4 class of hazards).  The
+mirror defect is the *dead* fallback: the attribute IS eagerly
+assigned, so the default branch is unreachable and misleads readers
+about the state machine.  ``__del__`` is exempt (an __init__ that
+raises legitimately leaves attrs unset there).  Classes whose bases
+cannot be resolved in-tree are skipped — we cannot see their eager
+set — and classes with no ``__init__`` and no class-level assigns are
+skipped for the same reason.
+
+R4: a *parameter* rebound inside a nested block and read again after
+that block is the PR 5 ``sel`` bug shape: a vectorizing temp clobbers
+the lane-index argument and every later consumer reads garbage.
+Excluded (legitimate idioms): the RHS reads the old value
+(``x = x[:n]``), the enclosing block's condition mentions the name
+(``if x is None: x = ...``), or the block consumed the old value
+before rebinding (filter/update patterns).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from gigapaxos_tpu.analysis.core import (Context, Finding, FUNC_NODES,
+                                         SourceFile, first_arg_name,
+                                         names_read)
+
+RULE_LAZY = "lazy-init"
+RULE_SHADOW = "shadow"
+
+
+# ---------------------------------------------------------------------------
+# R3
+
+
+def _assigned_self_attrs(fn, self_name: str) -> Set[str]:
+    out: Set[str] = set()
+    for node in ast.walk(fn):
+        tgts: List[ast.AST] = []
+        if isinstance(node, ast.Assign):
+            tgts = list(node.targets)
+        elif isinstance(node, (ast.AnnAssign, ast.AugAssign)):
+            tgts = [node.target]
+        elif isinstance(node, (ast.For, ast.AsyncFor)):
+            tgts = [node.target]
+        elif isinstance(node, (ast.With, ast.AsyncWith)):
+            tgts = [i.optional_vars for i in node.items
+                    if i.optional_vars is not None]
+        for t in tgts:
+            for el in (t.elts if isinstance(t, (ast.Tuple, ast.List))
+                       else [t]):
+                if (isinstance(el, ast.Attribute)
+                        and isinstance(el.value, ast.Name)
+                        and el.value.id == self_name):
+                    out.add(el.attr)
+        # setattr(self, "x", v) with a literal name
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id == "setattr" and len(node.args) >= 2
+                and isinstance(node.args[0], ast.Name)
+                and node.args[0].id == self_name
+                and isinstance(node.args[1], ast.Constant)
+                and isinstance(node.args[1].value, str)):
+            out.add(node.args[1].value)
+    return out
+
+
+def _class_index(ctx: Context) -> Dict[str, ast.ClassDef]:
+    idx: Dict[str, ast.ClassDef] = {}
+    for sf in ctx.files:
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.ClassDef):
+                idx.setdefault(node.name, node)
+    return idx
+
+
+def _eager_attrs(cls: ast.ClassDef, index: Dict[str, ast.ClassDef],
+                 seen: Optional[Set[str]] = None) -> Optional[Set[str]]:
+    """Attrs provably assigned by construction time, or None when the
+    class (or a base) is opaque and the rule must stay quiet."""
+    seen = seen or set()
+    if cls.name in seen:
+        return set()
+    seen.add(cls.name)
+    eager: Set[str] = set()
+    init = None
+    methods: Dict[str, ast.AST] = {}
+    for st in cls.body:
+        if isinstance(st, ast.Assign):
+            for t in st.targets:
+                if isinstance(t, ast.Name):
+                    eager.add(t.id)
+        elif isinstance(st, ast.AnnAssign) \
+                and isinstance(st.target, ast.Name):
+            eager.add(st.target.id)
+        elif isinstance(st, FUNC_NODES):
+            methods[st.name] = st
+            if st.name == "__init__":
+                init = st
+    # resolve bases: object/enum-free simple names found in-tree
+    for b in cls.bases:
+        name = b.id if isinstance(b, ast.Name) else None
+        if name in (None, "object"):
+            if name == "object":
+                continue
+            return None  # attribute/subscript base: opaque
+        base = index.get(name)
+        if base is None:
+            return None  # out-of-tree base: opaque
+        sub = _eager_attrs(base, index, seen)
+        if sub is None:
+            return None
+        eager |= sub
+    if init is None:
+        if not eager and not cls.bases:
+            return None  # nothing to reason about
+        return eager
+    self_name = first_arg_name(init) or "self"
+    eager |= _assigned_self_attrs(init, self_name)
+    # one level of self._helper() delegation from __init__
+    for node in ast.walk(init):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id == self_name):
+            helper = methods.get(node.func.attr)
+            if helper is not None:
+                hself = first_arg_name(helper) or "self"
+                eager |= _assigned_self_attrs(helper, hself)
+    return eager
+
+
+def check_lazy_init(ctx: Context) -> List[Finding]:
+    findings: List[Finding] = []
+    index = _class_index(ctx)
+    for sf in ctx.files:
+        for cls in ast.walk(sf.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            eager = _eager_attrs(cls, index)
+            if eager is None:
+                continue
+            for fn in cls.body:
+                if not isinstance(fn, FUNC_NODES) \
+                        or fn.name == "__del__":
+                    continue
+                self_name = first_arg_name(fn)
+                if self_name not in ("self", "cls"):
+                    continue
+                _scan_method(sf, cls, fn, self_name, eager, findings)
+    return findings
+
+
+def _scan_method(sf: SourceFile, cls: ast.ClassDef, fn, self_name,
+                 eager: Set[str], findings: List[Finding]) -> None:
+    qn = f"{cls.name}.{fn.name}"
+    for node in ast.walk(fn):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)):
+            continue
+        name = node.func.id
+        if name == "getattr" and len(node.args) == 3:
+            pass
+        elif name == "hasattr" and len(node.args) == 2:
+            pass
+        else:
+            continue
+        recv, attr = node.args[0], node.args[1]
+        if not (isinstance(recv, ast.Name) and recv.id == self_name):
+            continue
+        if not (isinstance(attr, ast.Constant)
+                and isinstance(attr.value, str)):
+            continue
+        a = attr.value
+        if a in eager:
+            findings.append(Finding(
+                RULE_LAZY, sf.rel, node.lineno, qn,
+                f"dead fallback: {name}(self, {a!r}, ...) but "
+                f"{cls.name}.__init__ always assigns .{a} — read "
+                f"it directly", sf.snippet(node)))
+        else:
+            findings.append(Finding(
+                RULE_LAZY, sf.rel, node.lineno, qn,
+                f"lazy-init hazard: {name}(self, {a!r}, ...) but "
+                f".{a} is never eagerly assigned in __init__ — "
+                f"initialize it there so every path sees one "
+                f"state machine", sf.snippet(node)))
+
+
+# ---------------------------------------------------------------------------
+# R4
+
+_BLOCK_NODES = (ast.If, ast.For, ast.AsyncFor, ast.While, ast.With,
+                ast.AsyncWith, ast.Try)
+
+
+def _cond_exprs(st: ast.stmt) -> List[ast.AST]:
+    if isinstance(st, (ast.If, ast.While)):
+        return [st.test]
+    if isinstance(st, (ast.For, ast.AsyncFor)):
+        return [st.iter, st.target]
+    if isinstance(st, (ast.With, ast.AsyncWith)):
+        return [i.context_expr for i in st.items]
+    return []
+
+
+def _reads_in_stmts(stmts: List[ast.stmt], name: str) -> bool:
+    for st in stmts:
+        for n in ast.walk(st):
+            if isinstance(n, ast.Name) and n.id == name \
+                    and isinstance(n.ctx, ast.Load):
+                return True
+    return False
+
+
+def check_shadowing(ctx: Context) -> List[Finding]:
+    findings: List[Finding] = []
+    for sf in ctx.files:
+        v = _ShadowVisitor(sf, findings)
+        v.visit(sf.tree)
+    return findings
+
+
+class _ShadowVisitor(ast.NodeVisitor):
+    def __init__(self, sf: SourceFile, findings: List[Finding]):
+        self.sf = sf
+        self.findings = findings
+        self._qual: List[str] = []
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        self._qual.append(node.name)
+        self.generic_visit(node)
+        self._qual.pop()
+
+    def _visit_func(self, node) -> None:
+        self._qual.append(node.name)
+        self._check_function(node)
+        self.generic_visit(node)
+        self._qual.pop()
+
+    visit_FunctionDef = _visit_func
+    visit_AsyncFunctionDef = _visit_func
+
+    def _check_function(self, fn) -> None:
+        a = fn.args
+        params = {x.arg for x in
+                  a.posonlyargs + a.args + a.kwonlyargs}
+        if a.vararg:
+            params.add(a.vararg.arg)
+        if a.kwarg:
+            params.add(a.kwarg.arg)
+        params.discard("self")
+        params.discard("cls")
+        if not params:
+            return
+        qn = ".".join(self._qual)
+        # chains: (enclosing blocks outermost-first, stmt, its block)
+        self._walk(fn.body, [], params, qn, fn)
+
+    def _walk(self, stmts, chain, params, qn, fn) -> None:
+        for st in stmts:
+            if isinstance(st, ast.Assign) and chain:
+                for t in st.targets:
+                    if isinstance(t, ast.Name) and t.id in params:
+                        self._check_rebind(st, t.id, chain, qn, fn)
+            for blk in self._blocks_of(st):
+                self._walk(blk, chain + [(st, stmts)], params, qn,
+                           fn)
+            # nested defs get their own _check_function pass
+            if isinstance(st, FUNC_NODES + (ast.ClassDef,)):
+                continue
+
+    @staticmethod
+    def _blocks_of(st: ast.stmt) -> List[List[ast.stmt]]:
+        if isinstance(st, FUNC_NODES + (ast.ClassDef,)):
+            return []
+        out = []
+        for f in ("body", "orelse", "finalbody"):
+            b = getattr(st, f, None)
+            if b:
+                out.append(b)
+        for h in getattr(st, "handlers", ()):
+            out.append(h.body)
+        return out
+
+    def _check_rebind(self, assign: ast.Assign, name: str, chain,
+                      qn: str, fn) -> None:
+        # (1) RHS reads the old value: x = x[:n] — legit narrowing
+        if name in names_read(assign.value):
+            return
+        # (2) any enclosing block's condition mentions the name:
+        #     `if x is None: x = default` and friends
+        for st, _body in chain:
+            for e in _cond_exprs(st):
+                if e is not None and name in names_read(e):
+                    return
+        # (3) the innermost block consumed the old value before the
+        #     rebind (filter/update patterns), or the rebind IS the
+        #     whole block (`if c: x = v` conditional-override idiom)
+        innermost_stmt, _innermost_parent = chain[-1]
+        for blk in self._blocks_of(innermost_stmt):
+            idx = next((i for i, s in enumerate(blk)
+                        if s is assign), None)
+            if idx is None:
+                continue
+            if len(blk) == 1:
+                return
+            if _reads_in_stmts(blk[:idx], name):
+                return
+        # (4) the name must be read again AFTER the innermost
+        #     enclosing block ends — otherwise the rebind is local
+        #     to the block and harmless
+        end = getattr(innermost_stmt, "end_lineno",
+                      innermost_stmt.lineno)
+        read_after = any(
+            isinstance(n, ast.Name) and n.id == name
+            and isinstance(n.ctx, ast.Load)
+            and n.lineno > end
+            for n in ast.walk(fn))
+        if not read_after:
+            return
+        self.findings.append(Finding(
+            RULE_SHADOW, self.sf.rel, assign.lineno, qn,
+            f"parameter {name!r} rebound inside a nested block and "
+            f"read again after it — later readers get the temp, "
+            f"not the argument (the PR 5 `sel` bug shape); rename "
+            f"the local", self.sf.snippet(assign)))

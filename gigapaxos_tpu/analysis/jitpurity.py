@@ -1,0 +1,208 @@
+"""Rule: jit-purity (R7).
+
+Functions handed to ``jax.jit`` / ``shard_map`` / ``lax.cond`` (and
+friends) trace ONCE and replay as XLA — any Python side effect in the
+body runs at trace time only, then silently never again.  Flagged:
+
+* attribute stores (``self.x = ...`` — mutating host state from a
+  traced body is the canonical silent-once bug);
+* subscript stores / container-mutator calls on *parameters or
+  captured names* (mutating a donated buffer or module global escapes
+  the trace; building up a fresh local list of arrays is fine and the
+  storm kernel does it on purpose);
+* ``global`` / ``nonlocal``;
+* print/logging calls (trace-time noise that vanishes in production);
+* ``time.*`` / ``random.*`` / ``np.random`` reads (baked into the
+  compiled graph as constants — nondeterminism that isn't).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from gigapaxos_tpu.analysis.core import (Context, Finding, FUNC_NODES,
+                                         SourceFile)
+
+RULE = "jit-purity"
+
+_WRAPPERS = {"jit", "shard_map", "pmap", "vmap_jit"}
+_LAX_SLOTS = {
+    "cond": (1, 2), "switch": (1,), "while_loop": (0, 1),
+    "scan": (0,), "fori_loop": (2,), "associative_scan": (0,),
+}
+_MUTATORS = {"append", "appendleft", "add", "insert", "extend",
+             "update", "setdefault", "pop", "popitem", "popleft",
+             "remove", "discard", "clear", "sort", "reverse"}
+_LOG_METHODS = {"debug", "info", "warning", "warn", "error",
+                "exception", "critical", "log"}
+
+
+def _dotted_tail(f: ast.AST) -> Tuple[Optional[str], Optional[str]]:
+    """``a.b.c`` -> ("b", "c"); ``c`` -> (None, "c")."""
+    if isinstance(f, ast.Attribute):
+        v = f.value
+        recv = v.id if isinstance(v, ast.Name) else (
+            v.attr if isinstance(v, ast.Attribute) else None)
+        return recv, f.attr
+    if isinstance(f, ast.Name):
+        return None, f.id
+    return None, None
+
+
+def _jit_targets(sf: SourceFile) -> List[Tuple[ast.AST, str]]:
+    """(function-def-or-lambda, how-it-got-traced) pairs."""
+    defs: Dict[str, ast.AST] = {}
+    for node in ast.walk(sf.tree):
+        if isinstance(node, FUNC_NODES):
+            defs.setdefault(node.name, node)
+    out: List[Tuple[ast.AST, str]] = []
+    seen: Set[int] = set()
+
+    def grab(expr: ast.AST, via: str) -> None:
+        target: Optional[ast.AST] = None
+        if isinstance(expr, ast.Lambda):
+            target = expr
+        elif isinstance(expr, ast.Name):
+            target = defs.get(expr.id)
+        if target is not None and id(target) not in seen:
+            seen.add(id(target))
+            out.append((target, via))
+
+    # decorators
+    for node in ast.walk(sf.tree):
+        if isinstance(node, FUNC_NODES):
+            for dec in node.decorator_list:
+                d = dec.func if isinstance(dec, ast.Call) else dec
+                recv, name = _dotted_tail(d)
+                if name in _WRAPPERS:
+                    if id(node) not in seen:
+                        seen.add(id(node))
+                        out.append((node, f"@{name}"))
+                elif name == "partial" and isinstance(dec, ast.Call) \
+                        and dec.args:
+                    r2, n2 = _dotted_tail(dec.args[0])
+                    if n2 in _WRAPPERS:
+                        if id(node) not in seen:
+                            seen.add(id(node))
+                            out.append((node, f"@partial({n2})"))
+    # call sites: jax.jit(f) / shard_map(f, ...) / lax.cond(p, a, b)
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        recv, name = _dotted_tail(node.func)
+        if name in _WRAPPERS and node.args:
+            grab(node.args[0], f"{name}()")
+        elif name in _LAX_SLOTS and recv == "lax":
+            for slot in _LAX_SLOTS[name]:
+                if slot < len(node.args):
+                    arg = node.args[slot]
+                    if isinstance(arg, (ast.List, ast.Tuple)):
+                        for el in arg.elts:
+                            grab(el, f"lax.{name}()")
+                    else:
+                        grab(arg, f"lax.{name}()")
+    return out
+
+
+def _local_names(fn: ast.AST) -> Set[str]:
+    out: Set[str] = set()
+    for node in ast.walk(fn):
+        tgts: List[ast.AST] = []
+        if isinstance(node, ast.Assign):
+            tgts = list(node.targets)
+        elif isinstance(node, (ast.AnnAssign,)):
+            tgts = [node.target]
+        elif isinstance(node, (ast.For, ast.AsyncFor)):
+            tgts = [node.target]
+        elif isinstance(node, ast.comprehension):
+            tgts = [node.target]
+        elif isinstance(node, (ast.With, ast.AsyncWith)):
+            tgts = [i.optional_vars for i in node.items
+                    if i.optional_vars is not None]
+        for t in tgts:
+            for el in (t.elts if isinstance(t, (ast.Tuple, ast.List))
+                       else [t]):
+                if isinstance(el, ast.Name):
+                    out.add(el.id)
+    return out
+
+
+def _check_body(sf: SourceFile, fn: ast.AST, via: str,
+                findings: List[Finding]) -> None:
+    if isinstance(fn, ast.Lambda):
+        qn, body_nodes = f"<lambda via {via}>", [fn.body]
+        locals_ = set()
+        params = {a.arg for a in fn.args.args}
+    else:
+        qn = fn.name
+        body_nodes = fn.body
+        locals_ = _local_names(fn)
+        a = fn.args
+        params = {x.arg for x in
+                  a.posonlyargs + a.args + a.kwonlyargs}
+        if a.vararg:
+            params.add(a.vararg.arg)
+        if a.kwarg:
+            params.add(a.kwarg.arg)
+
+    def owned(name: str) -> bool:
+        """A fresh local the trace may mutate freely."""
+        return name in locals_ and name not in params
+
+    def add(node: ast.AST, msg: str) -> None:
+        findings.append(Finding(
+            RULE, sf.rel, getattr(node, "lineno", 0), qn,
+            f"{msg} in function traced via {via} — traced bodies "
+            f"run once at trace time; side effects silently never "
+            f"replay", sf.snippet(node)))
+
+    for top in body_nodes:
+        for node in ast.walk(top):
+            if isinstance(node, (ast.Global, ast.Nonlocal)):
+                add(node, f"`{type(node).__name__.lower()}` "
+                          f"declaration")
+                continue
+            if isinstance(node, (ast.Assign, ast.AnnAssign,
+                                 ast.AugAssign)):
+                tgts = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for t in tgts:
+                    for el in (t.elts if isinstance(
+                            t, (ast.Tuple, ast.List)) else [t]):
+                        if isinstance(el, ast.Attribute):
+                            add(node, "attribute store "
+                                f"`{ast.unparse(el)} = ...`")
+                        elif isinstance(el, ast.Subscript) \
+                                and isinstance(el.value, ast.Name) \
+                                and not owned(el.value.id):
+                            add(node, "in-place subscript store on "
+                                f"non-local `{el.value.id}[...]`")
+            if isinstance(node, ast.Call):
+                recv, name = _dotted_tail(node.func)
+                if name == "print" and recv is None:
+                    add(node, "print() call")
+                elif recv in ("log", "logger", "logging") \
+                        and name in _LOG_METHODS:
+                    add(node, f"logging call ({recv}.{name})")
+                elif name in _MUTATORS \
+                        and isinstance(node.func, ast.Attribute) \
+                        and isinstance(node.func.value, ast.Name) \
+                        and not owned(node.func.value.id):
+                    add(node, f"container mutation "
+                        f"`{node.func.value.id}.{name}()` on a "
+                        f"parameter/captured name")
+                elif recv == "time" and name in (
+                        "time", "monotonic", "perf_counter",
+                        "thread_time"):
+                    add(node, f"host clock read time.{name}()")
+                elif recv == "random" and name is not None:
+                    add(node, f"host RNG read random.{name}()")
+
+
+def check(ctx: Context) -> List[Finding]:
+    findings: List[Finding] = []
+    for sf in ctx.files:
+        for fn, via in _jit_targets(sf):
+            _check_body(sf, fn, via, findings)
+    return findings

@@ -1,0 +1,166 @@
+"""Rule: PC knob registry (R6).
+
+The PR 6 class of leaks: a knob gets added, a test sets it, nothing
+restores it, and an unrelated test three files away inherits chaos
+delays.  This rule closes the loop mechanically:
+
+* every ``PC.X`` reference resolves to a declared member of the PC
+  enum (typo'd/undeclared knobs fail);
+* every declared member is referenced somewhere in the tree, tests,
+  or tools (stale knobs fail — dead config is worse than dead code,
+  people *set* it and nothing happens);
+* every declared member's name appears in README.md or MIGRATING.md
+  (undocumented knobs fail);
+* members of a declared family (``CHAOS_*``, ``TRACE_*``, ...) whose
+  state mirrors into a process-global singleton must have that
+  singleton's reset call in tests/conftest.py, so the family cannot
+  leak across tests;
+* every ``--flag`` the server exposes appears in README or MIGRATING.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from gigapaxos_tpu.analysis.core import Context, Finding, SourceFile
+
+RULE = "knobs"
+
+
+def _find_members(sf: SourceFile, knob_class: str) \
+        -> Optional[Dict[str, int]]:
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.ClassDef) and node.name == knob_class:
+            out: Dict[str, int] = {}
+            for st in node.body:
+                if isinstance(st, ast.Assign):
+                    for t in st.targets:
+                        if isinstance(t, ast.Name):
+                            out[t.id] = st.lineno
+                elif isinstance(st, ast.AnnAssign) \
+                        and isinstance(st.target, ast.Name):
+                    out[st.target.id] = st.lineno
+            return out
+    return None
+
+
+def _collect_refs(files: List[SourceFile], knob_class: str) \
+        -> Dict[str, List[Tuple[SourceFile, ast.Attribute]]]:
+    refs: Dict[str, List[Tuple[SourceFile, ast.Attribute]]] = {}
+    for sf in files:
+        for node in ast.walk(sf.tree):
+            if (isinstance(node, ast.Attribute)
+                    and isinstance(node.value, ast.Name)
+                    and node.value.id == knob_class):
+                refs.setdefault(node.attr, []).append((sf, node))
+    return refs
+
+
+def _conftest_calls(src: str) -> Set[str]:
+    """Dotted call names made anywhere in conftest
+    ("ChaosPlane.reset", "Config.clear", ...)."""
+    out: Set[str] = set()
+    try:
+        tree = ast.parse(src)
+    except SyntaxError:
+        return out
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute):
+            f = node.func
+            if isinstance(f.value, ast.Name):
+                out.add(f"{f.value.id}.{f.attr}")
+    return out
+
+
+def _server_flags(files: List[SourceFile]) \
+        -> List[Tuple[SourceFile, ast.Call, str]]:
+    out = []
+    for sf in files:
+        if not sf.rel.endswith("server.py"):
+            continue
+        for node in ast.walk(sf.tree):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "add_argument"
+                    and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)
+                    and node.args[0].value.startswith("--")):
+                out.append((sf, node, node.args[0].value))
+    return out
+
+
+def check(ctx: Context) -> List[Finding]:
+    findings: List[Finding] = []
+    decls = ctx.decls
+    kc = decls.knob_class
+    members: Optional[Dict[str, int]] = None
+    decl_sf: Optional[SourceFile] = None
+    for sf in ctx.files:
+        m = _find_members(sf, kc)
+        if m is not None:
+            members, decl_sf = m, sf
+            break
+    if members is None:
+        return findings  # no knob enum in this context (fixtures)
+    refs = _collect_refs(ctx.all_files(), kc)
+    resets = _conftest_calls(ctx.conftest_src)
+
+    # undeclared references
+    for name, sites in sorted(refs.items()):
+        if name in members:
+            continue
+        sf, node = sites[0]
+        findings.append(Finding(
+            RULE, sf.rel, node.lineno, "<module>",
+            f"{kc}.{name} is not declared in the knob enum — typo "
+            f"or the knob was removed", sf.snippet(node)))
+
+    for name, line in sorted(members.items()):
+        snippet = decl_sf.snippet(
+            type("_n", (), {"lineno": line})())
+        # stale: declared but never read anywhere
+        if name not in refs:
+            findings.append(Finding(
+                RULE, decl_sf.rel, line, f"{kc}.{name}",
+                f"knob {kc}.{name} is declared but never read by "
+                f"the tree, tests, or tools — wire it or delete "
+                f"it (dead config gets *set* and silently ignored)",
+                snippet))
+        # undocumented
+        if ctx.doc_text and name not in ctx.doc_text:
+            findings.append(Finding(
+                RULE, decl_sf.rel, line, f"{kc}.{name}",
+                f"knob {kc}.{name} is not mentioned in README.md "
+                f"or MIGRATING.md", snippet))
+        # family reset coverage
+        for prefix, resetter in sorted(decls.knob_families.items(),
+                                       key=lambda kv: -len(kv[0])):
+            if not name.startswith(prefix):
+                continue
+            if resetter is not None and resetter not in resets:
+                findings.append(Finding(
+                    RULE, decl_sf.rel, line, f"{kc}.{name}",
+                    f"knob family {prefix}* mirrors into a global "
+                    f"singleton but tests/conftest.py never calls "
+                    f"{resetter}() — the {name} state leaks "
+                    f"across tests", snippet))
+            break
+        else:
+            # no family matched: generic Config coverage required
+            if ctx.conftest_src and "Config.clear" not in resets:
+                findings.append(Finding(
+                    RULE, decl_sf.rel, line, f"{kc}.{name}",
+                    "tests/conftest.py never calls Config.clear() "
+                    "— every knob leaks across tests", snippet))
+
+    # server --flags must be documented
+    for sf, node, flag in _server_flags(ctx.files):
+        if ctx.doc_text and flag not in ctx.doc_text:
+            findings.append(Finding(
+                RULE, sf.rel, node.lineno, "<cli>",
+                f"server flag {flag} is not mentioned in README.md "
+                f"or MIGRATING.md", sf.snippet(node)))
+    return findings

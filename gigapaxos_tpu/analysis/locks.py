@@ -1,0 +1,521 @@
+"""Rules: lock-order (R1) and shared-state race lint (R2).
+
+R1 builds the lexical lock-acquisition graph: every ``with <lock>``
+nested inside another ``with <lock>`` is an observed outer->inner
+edge.  Edges that contradict ``decls.lock_order``, edges out of a
+declared leaf lock, cycles in the observed graph, re-entrant
+acquisition of non-reentrant locks, and *accumulating* acquisition of
+an indexed lock list (ExitStack) outside the declared ordered helper
+are all findings.  Known limitation (documented in README): edges are
+lexical per function — an edge through a call chain is invisible, so
+the declared order carries the interprocedural contract.
+
+R2 flags mutations of declared-guarded attributes outside ``with
+<their lock>``: ``self.n += 1``, ``self.d[k] = v``, rebinding, del,
+mutator method calls (``.append``/``.pop``/...), and
+``heapq.heappush(self.x, ...)``.  ``__init__``/``__new__`` are exempt
+(no second thread exists yet); nested ``def`` bodies are checked with
+an empty held-set (a closure may run after the lock is released).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from gigapaxos_tpu.analysis.core import (Context, Finding, FUNC_NODES,
+                                         SourceFile, first_arg_name,
+                                         self_attr)
+
+MUTATORS = frozenset({
+    "append", "appendleft", "add", "insert", "extend", "update",
+    "setdefault", "pop", "popitem", "popleft", "remove", "discard",
+    "clear", "sort", "reverse",
+})
+HEAP_FNS = frozenset({"heappush", "heappop", "heapify", "heapreplace",
+                      "heappushpop"})
+
+
+def _receivers(class_name: str, func) -> Set[str]:
+    recv = {"self", "cls", class_name}
+    first = first_arg_name(func) if func is not None else None
+    if first:
+        recv.add(first)
+    return recv
+
+
+def _attr_of(expr: ast.AST, recv: Set[str]) -> Optional[str]:
+    """``<recv>.X`` -> X for any receiver name in ``recv``."""
+    if (isinstance(expr, ast.Attribute)
+            and isinstance(expr.value, ast.Name)
+            and expr.value.id in recv):
+        return expr.attr
+    return None
+
+
+class _LockRef:
+    """A resolved lock expression."""
+
+    def __init__(self, lid: str, attr: str, indexed: bool,
+                 index: Optional[ast.AST] = None):
+        self.lid = lid          # canonical "Class.attr"
+        self.attr = attr
+        self.indexed = indexed  # came from a Subscript of a lock list
+        self.index = index
+
+
+def _resolve_lock(expr: ast.AST, class_name: Optional[str],
+                  recv: Set[str], decls,
+                  local_locks: Dict[str, "_LockRef"]) -> \
+        Optional[_LockRef]:
+    if isinstance(expr, ast.Name) and expr.id in local_locks:
+        return local_locks[expr.id]
+    if isinstance(expr, ast.IfExp):
+        a = _resolve_lock(expr.body, class_name, recv, decls,
+                          local_locks)
+        b = _resolve_lock(expr.orelse, class_name, recv, decls,
+                          local_locks)
+        if a and b and a.lid == b.lid:
+            return a
+        return a or b
+    indexed, index = False, None
+    if isinstance(expr, ast.Subscript):
+        indexed, index = True, expr.slice
+        expr = expr.value
+    attr = _attr_of(expr, recv)
+    owner = class_name
+    if attr is None and isinstance(expr, ast.Attribute) \
+            and isinstance(expr.value, ast.Name) \
+            and expr.value.id in decls.threaded:
+        # ClassName._lock from outside the class
+        owner, attr = expr.value.id, expr.attr
+    if attr is None or owner is None:
+        return None
+    tc = decls.threaded.get(owner)
+    if tc is None or attr not in tc.locks:
+        return None
+    lid = decls.lock_aliases.get(f"{owner}.{attr}", f"{owner}.{attr}")
+    if not indexed and lid in decls.indexed_locks \
+            and f"{owner}.{attr}" != lid:
+        # alias of one element of an indexed list (e.g. _engine_lock
+        # is lane 0): a plain, ordered-by-definition acquisition
+        indexed = True
+        index = ast.Constant(0)
+    return _LockRef(lid, attr, indexed, index)
+
+
+def _is_rlock(ref: _LockRef, decls) -> bool:
+    owner, attr = ref.lid.split(".", 1)
+    tc = decls.threaded.get(owner)
+    if tc is None:
+        return False
+    return attr in tc.rlocks or ref.attr in tc.rlocks
+
+
+def _iter_is_ordered(it: ast.AST, class_name: Optional[str],
+                     recv: Set[str], decls) -> bool:
+    """True when a ``for`` iterable provably yields locks in canonical
+    order: ``sorted(...)`` or a declared ordered helper call."""
+    if isinstance(it, ast.Call):
+        if isinstance(it.func, ast.Name) and it.func.id == "sorted":
+            return True
+        helper = _attr_of(it.func, recv)
+        if helper is not None and class_name is not None:
+            for lid, helpers in decls.indexed_locks.items():
+                if lid.startswith(class_name + ".") \
+                        and helper in helpers:
+                    return True
+    return False
+
+
+class _OrderWalker:
+    """Per-function lexical walk collecting acquisitions and edges."""
+
+    def __init__(self, sf: SourceFile, class_name: Optional[str],
+                 func, qualname: str, decls, edges, findings):
+        self.sf = sf
+        self.class_name = class_name
+        self.qualname = qualname
+        self.decls = decls
+        self.edges = edges          # list[(src_lid, dst_lid, sf, node, qn)]
+        self.findings = findings
+        self.recv = _receivers(class_name or "", func)
+        self.local_locks: Dict[str, _LockRef] = {}
+
+    def _finding(self, node: ast.AST, msg: str) -> None:
+        self.findings.append(Finding(
+            "lock-order", self.sf.rel, getattr(node, "lineno", 0),
+            self.qualname, msg, self.sf.snippet(node)))
+
+    def _acquire(self, ref: _LockRef, node: ast.AST,
+                 held: List[_LockRef]) -> None:
+        for h in held:
+            if h.lid == ref.lid:
+                same_const_index = (
+                    isinstance(ref.index, ast.Constant)
+                    and isinstance(h.index, ast.Constant)
+                    and ref.index.value == h.index.value)
+                if same_const_index and _is_rlock(ref, self.decls):
+                    continue  # same lane, reentrant: legal
+                if ref.indexed:
+                    self._finding(node, (
+                        f"second acquisition of indexed lock "
+                        f"{ref.lid} while one element is already "
+                        f"held — acquire the whole set via its "
+                        f"ordered helper instead"))
+                elif not _is_rlock(ref, self.decls):
+                    self._finding(node, (
+                        f"re-entrant acquisition of non-reentrant "
+                        f"lock {ref.lid}"))
+            else:
+                self.edges.append((h.lid, ref.lid, self.sf, node,
+                                   self.qualname))
+
+    def walk(self, stmts: List[ast.stmt],
+             held: List[_LockRef]) -> None:
+        # `held` grows within this block when an ExitStack For
+        # accumulates locks that stay held for the rest of the block
+        held = list(held)
+        for st in stmts:
+            if isinstance(st, (ast.With, ast.AsyncWith)):
+                acquired = []
+                for item in st.items:
+                    ref = _resolve_lock(item.context_expr,
+                                        self.class_name, self.recv,
+                                        self.decls, self.local_locks)
+                    if ref is not None:
+                        self._acquire(ref, st, held + acquired)
+                        acquired.append(ref)
+                self.walk(st.body, held + acquired)
+            elif isinstance(st, (ast.For, ast.AsyncFor)):
+                held.extend(self._for_stmt(st, held))
+            elif isinstance(st, ast.If):
+                self.walk(st.body, held)
+                self.walk(st.orelse, held)
+            elif isinstance(st, ast.While):
+                self.walk(st.body, held)
+                self.walk(st.orelse, held)
+            elif isinstance(st, ast.Try):
+                self.walk(st.body, held)
+                for h in st.handlers:
+                    self.walk(h.body, held)
+                self.walk(st.orelse, held)
+                self.walk(st.finalbody, held)
+            elif isinstance(st, FUNC_NODES):
+                # a closure runs later: fresh held-set
+                sub = _OrderWalker(self.sf, self.class_name, st,
+                                   f"{self.qualname}.{st.name}",
+                                   self.decls, self.edges,
+                                   self.findings)
+                sub.walk(st.body, [])
+            elif isinstance(st, ast.Assign) \
+                    and len(st.targets) == 1 \
+                    and isinstance(st.targets[0], ast.Name):
+                ref = _resolve_lock(st.value, self.class_name,
+                                    self.recv, self.decls,
+                                    self.local_locks)
+                if ref is not None:
+                    self.local_locks[st.targets[0].id] = ref
+
+    def _for_stmt(self, st, held: List[_LockRef]) -> List[_LockRef]:
+        """Handle a For: detect ExitStack lock accumulation.  Returns
+        lock refs that stay held for the rest of the enclosing block."""
+        target = st.target.id if isinstance(st.target, ast.Name) \
+            else None
+        ordered = _iter_is_ordered(st.iter, self.class_name,
+                                   self.recv, self.decls)
+        accumulated: List[_LockRef] = []
+        for node in ast.walk(st):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "enter_context"
+                    and node.args):
+                continue
+            arg = node.args[0]
+            ref = _resolve_lock(arg, self.class_name, self.recv,
+                                self.decls, self.local_locks)
+            via_target = (isinstance(arg, ast.Name)
+                          and arg.id == target)
+            if ref is None and not via_target:
+                continue
+            if ref is not None and not ref.indexed and not via_target:
+                # a plain lock entered inside a loop body
+                self._acquire(ref, node, held + accumulated)
+                accumulated.append(ref)
+                continue
+            # accumulating acquisition of an indexed lock list: the
+            # iterable must be provably ordered
+            if not ordered:
+                self._finding(node, (
+                    "accumulating lock acquisition inside a loop "
+                    "whose iterable is not sorted(...) or a "
+                    "declared ordered helper — lane-lock order "
+                    "must be by index to stay deadlock-free"))
+            if ref is not None:
+                self._acquire(ref, node, held + accumulated)
+                accumulated.append(ref)
+            elif ordered and self.class_name is not None:
+                # helper-yielded locks: held as the container id
+                helper = _attr_of(st.iter.func, self.recv) \
+                    if isinstance(st.iter, ast.Call) else None
+                for lid, helpers in self.decls.indexed_locks.items():
+                    if helper and helper in helpers \
+                            and lid.startswith(self.class_name + "."):
+                        cref = _LockRef(lid, lid.split(".", 1)[1],
+                                        True)
+                        self._acquire(cref, node, held + accumulated)
+                        accumulated.append(cref)
+        # nested withs inside the loop body see the accumulation
+        self.walk(st.body, held + accumulated)
+        self.walk(st.orelse, held + accumulated)
+        return accumulated
+
+
+def _check_helper_sorts(ctx: Context, findings: List[Finding]) -> None:
+    """A declared ordered helper must actually sort."""
+    wanted: Dict[Tuple[str, str], str] = {}
+    for lid, helpers in ctx.decls.indexed_locks.items():
+        owner = lid.split(".", 1)[0]
+        for h in helpers:
+            wanted[(owner, h)] = lid
+    if not wanted:
+        return
+    for sf in ctx.files:
+        for cls in ast.walk(sf.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            for fn in cls.body:
+                if not isinstance(fn, FUNC_NODES):
+                    continue
+                lid = wanted.get((cls.name, fn.name))
+                if lid is None:
+                    continue
+                sorts = any(
+                    isinstance(n, ast.Call)
+                    and isinstance(n.func, ast.Name)
+                    and n.func.id in ("sorted",)
+                    for n in ast.walk(fn))
+                if not sorts:
+                    findings.append(Finding(
+                        "lock-order", sf.rel, fn.lineno,
+                        f"{cls.name}.{fn.name}",
+                        f"declared ordered helper for {lid} does "
+                        f"not call sorted() — it no longer "
+                        f"guarantees index order", sf.snippet(fn)))
+
+
+def check_lock_order(ctx: Context) -> List[Finding]:
+    findings: List[Finding] = []
+    edges: List[tuple] = []
+    for sf in ctx.files:
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            for fn in node.body:
+                if isinstance(fn, FUNC_NODES):
+                    w = _OrderWalker(sf, node.name, fn,
+                                     f"{node.name}.{fn.name}",
+                                     ctx.decls, edges, findings)
+                    w.walk(fn.body, [])
+        # module-level functions (lock use via ClassName.attr)
+        for fn in sf.tree.body:
+            if isinstance(fn, FUNC_NODES):
+                w = _OrderWalker(sf, None, fn, fn.name, ctx.decls,
+                                 edges, findings)
+                w.walk(fn.body, [])
+    order = {lid: i for i, lid in enumerate(ctx.decls.lock_order)}
+    graph: Dict[str, Set[str]] = {}
+    seen_edges: Set[Tuple[str, str, str]] = set()
+    for src, dst, sf, node, qn in edges:
+        graph.setdefault(src, set()).add(dst)
+        key = (src, dst, qn)
+        if key in seen_edges:
+            continue
+        seen_edges.add(key)
+        if src in ctx.decls.leaf_locks:
+            findings.append(Finding(
+                "lock-order", sf.rel, node.lineno, qn,
+                f"{dst} acquired while holding leaf lock {src} — "
+                f"leaf locks guard O(1) regions and must be "
+                f"innermost", sf.snippet(node)))
+        elif src in order and dst in order \
+                and order[src] > order[dst]:
+            findings.append(Finding(
+                "lock-order", sf.rel, node.lineno, qn,
+                f"{dst} acquired while holding {src}, but the "
+                f"declared order is "
+                f"{' -> '.join(ctx.decls.lock_order)}",
+                sf.snippet(node)))
+    # cycle detection over the observed graph
+    state: Dict[str, int] = {}
+
+    def dfs(n: str, path: List[str]) -> Optional[List[str]]:
+        state[n] = 1
+        for m in sorted(graph.get(n, ())):
+            if state.get(m) == 1:
+                return path + [n, m]
+            if state.get(m, 0) == 0:
+                cyc = dfs(m, path + [n])
+                if cyc:
+                    return cyc
+        state[n] = 2
+        return None
+
+    for n in sorted(graph):
+        if state.get(n, 0) == 0:
+            cyc = dfs(n, [])
+            if cyc:
+                src, dst = cyc[-2], cyc[-1]
+                for s, d, sf, node, qn in edges:
+                    if (s, d) == (src, dst):
+                        findings.append(Finding(
+                            "lock-order", sf.rel, node.lineno, qn,
+                            "lock-acquisition cycle: "
+                            + " -> ".join(cyc[cyc.index(dst):]),
+                            sf.snippet(node)))
+                        break
+    _check_helper_sorts(ctx, findings)
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# R2: shared-state race lint
+
+
+class _RaceWalker:
+    def __init__(self, sf: SourceFile, class_name: str, tc, func,
+                 qualname: str, decls, findings: List[Finding]):
+        self.sf = sf
+        self.class_name = class_name
+        self.tc = tc
+        self.qualname = qualname
+        self.decls = decls
+        self.findings = findings
+        self.recv = _receivers(class_name, func)
+
+    def _finding(self, node: ast.AST, attr: str, lock: str) -> None:
+        self.findings.append(Finding(
+            "race", self.sf.rel, getattr(node, "lineno", 0),
+            self.qualname,
+            f"mutation of {self.class_name}.{attr} outside "
+            f"`with {lock}` — declared shared across threads",
+            self.sf.snippet(node)))
+
+    def _guard(self, attr: Optional[str]) -> Optional[str]:
+        if attr is None:
+            return None
+        return self.tc.guarded.get(attr)
+
+    def _check_expr(self, node: ast.AST, held: Set[str]) -> None:
+        """Mutator calls reached through expressions."""
+        for call in ast.walk(node):
+            if not isinstance(call, ast.Call):
+                continue
+            f = call.func
+            if isinstance(f, ast.Attribute) and f.attr in MUTATORS:
+                attr = _attr_of(f.value, self.recv)
+                lock = self._guard(attr)
+                if lock and lock not in held:
+                    self._finding(call, attr, lock)
+            fname = f.attr if isinstance(f, ast.Attribute) else (
+                f.id if isinstance(f, ast.Name) else None)
+            if fname in HEAP_FNS and call.args:
+                attr = _attr_of(call.args[0], self.recv)
+                lock = self._guard(attr)
+                if lock and lock not in held:
+                    self._finding(call, attr, lock)
+
+    def _check_store(self, tgt: ast.AST, node: ast.AST,
+                     held: Set[str]) -> None:
+        base = tgt
+        if isinstance(base, ast.Subscript):
+            base = base.value
+        attr = _attr_of(base, self.recv)
+        lock = self._guard(attr)
+        if lock and lock not in held:
+            self._finding(node, attr, lock)
+
+    def walk(self, stmts: List[ast.stmt], held: Set[str]) -> None:
+        for st in stmts:
+            if isinstance(st, (ast.With, ast.AsyncWith)):
+                got = set()
+                for item in st.items:
+                    ref = _resolve_lock(item.context_expr,
+                                        self.class_name, self.recv,
+                                        self.decls, {})
+                    if ref is not None:
+                        got.add(ref.attr)
+                        # alias: holding _engine_lock == holding the
+                        # canonical container attr too
+                        got.add(ref.lid.split(".", 1)[1])
+                self.walk(st.body, held | got)
+                continue
+            if isinstance(st, FUNC_NODES):
+                # closures may outlive the lock scope
+                sub = _RaceWalker(self.sf, self.class_name, self.tc,
+                                  st, f"{self.qualname}.{st.name}",
+                                  self.decls, self.findings)
+                sub.walk(st.body, set())
+                continue
+            if isinstance(st, ast.If):
+                self._check_expr(st.test, held)
+                self.walk(st.body, held)
+                self.walk(st.orelse, held)
+            elif isinstance(st, ast.While):
+                self._check_expr(st.test, held)
+                self.walk(st.body, held)
+                self.walk(st.orelse, held)
+            elif isinstance(st, (ast.For, ast.AsyncFor)):
+                self._check_expr(st.iter, held)
+                self._check_store(st.target, st, held)
+                self.walk(st.body, held)
+                self.walk(st.orelse, held)
+            elif isinstance(st, ast.Try):
+                self.walk(st.body, held)
+                for h in st.handlers:
+                    self.walk(h.body, held)
+                self.walk(st.orelse, held)
+                self.walk(st.finalbody, held)
+            elif isinstance(st, ast.AugAssign):
+                self._check_store(st.target, st, held)
+                self._check_expr(st, held)
+            elif isinstance(st, ast.Assign):
+                for t in st.targets:
+                    targets = t.elts if isinstance(
+                        t, (ast.Tuple, ast.List)) else [t]
+                    for tt in targets:
+                        self._check_store(tt, st, held)
+                self._check_expr(st, held)
+            elif isinstance(st, ast.AnnAssign):
+                if st.value is not None:
+                    self._check_store(st.target, st, held)
+                self._check_expr(st, held)
+            elif isinstance(st, ast.Delete):
+                for t in st.targets:
+                    self._check_store(t, st, held)
+            elif isinstance(st, ast.ClassDef):
+                pass  # nested class bodies are out of scope
+            else:
+                self._check_expr(st, held)
+
+
+def check_races(ctx: Context) -> List[Finding]:
+    findings: List[Finding] = []
+    for sf in ctx.files:
+        for cls in ast.walk(sf.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            tc = ctx.decls.threaded.get(cls.name)
+            if tc is None or not tc.guarded:
+                continue
+            for fn in cls.body:
+                if not isinstance(fn, FUNC_NODES):
+                    continue
+                if fn.name in ("__init__", "__new__") \
+                        or fn.name in tc.exempt_methods:
+                    continue
+                w = _RaceWalker(sf, cls.name, tc, fn,
+                                f"{cls.name}.{fn.name}", ctx.decls,
+                                findings)
+                w.walk(fn.body, set())
+    return findings

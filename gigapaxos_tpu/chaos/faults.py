@@ -156,7 +156,7 @@ class ChaosPlane:
         failure: src's frames to dst vanish; dst -> src still flows)."""
         with cls._lock:
             cls._blocked.add((int(src), int(dst)))
-        cls.enabled = True
+            cls.enabled = True
 
     @classmethod
     def unblock(cls, src: int, dst: int) -> None:
@@ -174,7 +174,7 @@ class ChaosPlane:
                         for d in b:
                             cls._blocked.add((int(s), int(d)))
                             cls._blocked.add((int(d), int(s)))
-        cls.enabled = True
+            cls.enabled = True
 
     @classmethod
     def heal(cls) -> None:
@@ -198,7 +198,8 @@ class ChaosPlane:
     def reset(cls) -> None:
         """clear() + default seed (the test-harness hygiene hook)."""
         cls.clear()
-        cls.seed = 0
+        with cls._lock:
+            cls.seed = 0
 
     @classmethod
     def configure_from_pc(cls) -> None:

@@ -630,6 +630,9 @@ class ColumnarBackend(AcceptorBackend):
                 len(devs) > 1 and capacity % len(devs) == 0:
             from jax.sharding import Mesh
             self._mesh = Mesh(np.asarray(devs), ("groups",))
+        # resolve the tri-state arg into a local; the parameter itself
+        # is never rebound (analysis `shadow` rule)
+        pallas_ok = use_pallas_accept
         if self._mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec
             ns = NamedSharding(self._mesh, PartitionSpec("groups"))
@@ -637,7 +640,7 @@ class ColumnarBackend(AcceptorBackend):
                 self.state,
                 jax.tree_util.tree_map(lambda _: ns, self.state))
             self._repl = NamedSharding(self._mesh, PartitionSpec())
-            use_pallas_accept = False  # Mosaic path is single-device
+            pallas_ok = False  # Mosaic path is single-device
         elif pinned:
             # single-device pin: host XLA next to a remote accelerator
             self.state = jax.device_put(self.state, devs[0])
@@ -649,17 +652,17 @@ class ColumnarBackend(AcceptorBackend):
         self._pallas = None
         from gigapaxos_tpu.utils.config import Config
         from gigapaxos_tpu.paxos.paxosconfig import PC
-        if use_pallas_accept is None:
-            use_pallas_accept = bool(Config.get(PC.USE_PALLAS_ACCEPT))
-        if use_pallas_accept and capacity % 8 != 0:
+        if pallas_ok is None:
+            pallas_ok = bool(Config.get(PC.USE_PALLAS_ACCEPT))
+        if pallas_ok and capacity % 8 != 0:
             # the octile kernel requires G % 8 == 0 (a partial last
             # octile would let grid padding alias a real one)
-            use_pallas_accept = False
+            pallas_ok = False
         # see _CPU_MESH_DISPATCH_LOCK: serialize sharded host-XLA
         # programs across an in-process multi-node emulation
         self._serialize_dispatch = (self._mesh is not None
                                     and devs[0].platform == "cpu")
-        if use_pallas_accept:
+        if pallas_ok:
             try:
                 from gigapaxos_tpu.ops.pallas_accept import PallasAccept
                 # devs[0] (the resolved engine device), NOT

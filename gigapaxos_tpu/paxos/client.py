@@ -54,6 +54,14 @@ class PaxosClientAsync:
         self._conn_locks: Dict[int, asyncio.Lock] = {}
         self._waiting: Dict[int, asyncio.Future] = {}
         self._preferred = 0
+        # client-side pushback (ref: the reference's outstanding-
+        # request table cap): at most PC.CLIENT_MAX_OUTSTANDING
+        # requests in flight per client; excess senders queue on the
+        # semaphore instead of piling up retransmit state.  Created
+        # lazily inside the running loop (the sync wrapper builds the
+        # client on one thread and runs it on another).
+        self._max_outstanding = 0
+        self._outstanding: Optional[asyncio.Semaphore] = None
 
     def next_req_id(self) -> int:
         return (self.id << 32) | next(self._seq)
@@ -98,7 +106,23 @@ class PaxosClientAsync:
     async def send_request(self, name: str, payload: bytes,
                            flags: int = 0) -> pkt.Response:
         """Send to the preferred replica; on timeout retransmit (same id —
-        dedup is server-side) to the next replica."""
+        dedup is server-side) to the next replica.  In-flight requests
+        per client are capped at ``PC.CLIENT_MAX_OUTSTANDING`` (0
+        disables): callers past the cap wait their turn here."""
+        if self._outstanding is None:
+            from gigapaxos_tpu.paxos.paxosconfig import PC
+            from gigapaxos_tpu.utils.config import Config
+            self._max_outstanding = max(
+                0, int(Config.get(PC.CLIENT_MAX_OUTSTANDING)))
+            self._outstanding = asyncio.Semaphore(
+                self._max_outstanding or 1)
+        if self._max_outstanding:
+            async with self._outstanding:
+                return await self._send_request(name, payload, flags)
+        return await self._send_request(name, payload, flags)
+
+    async def _send_request(self, name: str, payload: bytes,
+                            flags: int = 0) -> pkt.Response:
         gkey = pkt.group_key(name)
         req_id = self.next_req_id()
         # mint the trace context at the client (the cluster tracing

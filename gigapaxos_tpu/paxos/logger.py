@@ -213,8 +213,12 @@ class PaxosLogger:
         # slice of a traced request's decomposition
         sp = RequestInstrumenter.span_begin("wal", entries=n_entries,
                                             seg=seg)
-        wal = self._wals[seg]
         with self._wal_locks[seg]:
+            # the handle MUST be read under the lock: compact_segment
+            # swaps self._wals[seg] and closes the old handle while
+            # holding it, so a reference captured before blocking on
+            # the lock dangles at a closed file
+            wal = self._wals[seg]
             wal.write(buf)
             wal.flush()
             if self.sync if fsync is None else fsync:
@@ -270,8 +274,9 @@ class PaxosLogger:
                         chunks.append(e.payload)
             try:
                 for seg, chunks in bufs.items():
-                    wal = self._wals[seg]
                     with self._wal_locks[seg]:
+                        # read under the lock — see log_raw_inline
+                        wal = self._wals[seg]
                         wal.write(b"".join(chunks))
                         wal.flush()
                         if self.sync:

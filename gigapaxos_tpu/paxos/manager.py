@@ -1015,8 +1015,7 @@ class PaxosNode:
         now = time.time()
         _num, coord = unpack_ballot(int(self._bal[meta.row]))
         if coord >= 0 and coord != self.id and coord in self.addr_map:
-            last = self._last_heard.get(coord,
-                                        getattr(self, "_boot_ts", now))
+            last = self._last_heard.get(coord, self._boot_ts)
             if now - last > self.failure_timeout:
                 self._run_if_next_in_line(meta, coord, now)
         return meta

@@ -85,6 +85,11 @@ class Reconfigurator:
         self._demand: Dict[str, int] = {}
         self.demand_policy = demand_policy
         self._last_retry = 0.0
+        # re-drive backoff clocks: (name, state, epoch) -> (due,
+        # attempts), rebuilt by every _tick pass (analysis `lazy-init`
+        # rule: eagerly initialized so the first tick and every later
+        # tick share one state machine)
+        self._state_ts: Dict[tuple, tuple] = {}
         from gigapaxos_tpu.reconfiguration.rcconfig import RC
         from gigapaxos_tpu.utils.config import Config as _C
         self.retry_s = float(_C.get(RC.RETRY_S))
@@ -643,7 +648,7 @@ class Reconfigurator:
         # actives (measured: 10x churn slowdown)
         start_by_active: Dict[int, list] = {}
         stop_by_active: Dict[int, list] = {}
-        state_ts = getattr(self, "_state_ts", {})
+        state_ts = self._state_ts
         new_ts: Dict[tuple, tuple] = {}
         for grp in self.my_groups():
             for rec in list(self.db.groups.get(grp, {}).values()):

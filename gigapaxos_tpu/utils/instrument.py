@@ -285,7 +285,10 @@ class RequestInstrumenter:
         leaked its handle).  Returns how many items were evicted."""
         if now is None:
             now = time.monotonic()
-        cls._last_evict = now
+        # under the lock: concurrent stage threads racing past the
+        # _maybe_evict throttle would otherwise both stamp + sweep
+        with cls._lock:
+            cls._last_evict = now
         if cls.max_age_s <= 0:
             return 0
         cutoff = now - cls.max_age_s

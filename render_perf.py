@@ -301,6 +301,7 @@ def render() -> str:
             "ceiling |")
 
     out.extend(_chaos_rows())
+    out.extend(_analysis_rows())
 
     out.append("")
     out.append(END)
@@ -350,6 +351,36 @@ def _chaos_rows():
             f"{'; '.join(parts) if parts else 'none'}; recovery "
             f"{r.get('recovery_s')} s; {r.get('acked')} acked ops, "
             f"{r.get('client_errors')} client timeouts |")
+    return out
+
+
+def _analysis_rows():
+    """Hygiene row from the newest tracked ``ANALYSIS_*.json``
+    (`python -m gigapaxos_tpu.analysis --out ...`): finding counts per
+    rule over the whole tree.  A non-zero NEW count here means someone
+    regenerated the artifact without fixing or baselining — the same
+    drift-visibility the perf rows give throughput."""
+    files = sorted(glob.glob(os.path.join(HERE, "ANALYSIS_*.json")))
+    files = [f for f in files
+             if not f.endswith("ANALYSIS_BASELINE.json")]
+    if not files:
+        return []
+    name = os.path.basename(files[-1])
+    art = _load(name)
+    if not art:
+        return []
+    new = art.get("new", 0)
+    base = art.get("baselined", 0)
+    per_rule = art.get("per_rule", {})
+    breakdown = ", ".join(
+        f"{r} {n}" for r, n in sorted(per_rule.items()) if n)
+    verdict = "**clean**" if not new else f"**{new} NEW finding(s)**"
+    out = [
+        f"| Static analysis, {len(art.get('rules', []))} rules over "
+        f"{art.get('files_scanned')} files (`{name}`) | {verdict}"
+        + (f" ({breakdown})" if breakdown else "")
+        + (f"; {base} baselined" if base else "")
+        + f"; {art.get('elapsed_s')} s |"]
     return out
 
 

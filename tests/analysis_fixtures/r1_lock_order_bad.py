@@ -1,0 +1,37 @@
+"""Forged R1 violations: contradicted order, cycle, unordered
+accumulation, leaf-lock nesting.  Never imported — parsed only."""
+
+import contextlib
+import threading
+
+
+class Node:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+        self._leaf = threading.Lock()
+        self._lanes = [threading.RLock() for _ in range(4)]
+
+    def forward(self):
+        with self._a:
+            with self._b:          # a -> b (declared order)
+                pass
+
+    def backward(self):
+        with self._b:
+            with self._a:          # b -> a: contradiction + cycle
+                pass
+
+    def from_leaf(self):
+        with self._leaf:
+            with self._a:          # leaf must be innermost
+                pass
+
+    def grab_unordered(self, ks):
+        with contextlib.ExitStack() as st:
+            for k in ks:           # iterable not sorted / helper
+                st.enter_context(self._lanes[k])
+
+    def _locks_for(self, ks):
+        # declared ordered helper that FORGOT to sort
+        return [self._lanes[k] for k in set(ks)]

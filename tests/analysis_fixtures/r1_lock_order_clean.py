@@ -1,0 +1,32 @@
+"""Clean twin of r1_lock_order_bad: declared order respected,
+accumulation via sorted()/the ordered helper."""
+
+import contextlib
+import threading
+
+
+class Node:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+        self._leaf = threading.Lock()
+        self._lanes = [threading.RLock() for _ in range(4)]
+
+    def forward(self):
+        with self._a:
+            with self._b:
+                with self._leaf:   # leaf innermost: fine
+                    pass
+
+    def grab_sorted(self, ks):
+        with contextlib.ExitStack() as st:
+            for k in sorted(set(ks)):
+                st.enter_context(self._lanes[k])
+
+    def grab_helper(self, ks):
+        with contextlib.ExitStack() as st:
+            for lk in self._locks_for(ks):
+                st.enter_context(lk)
+
+    def _locks_for(self, ks):
+        return [self._lanes[k] for k in sorted(set(ks))]

@@ -1,0 +1,24 @@
+"""Forged R2 violations: guarded state mutated without the lock."""
+
+import heapq
+import threading
+
+
+class Node:
+    def __init__(self):
+        self._stat_lock = threading.Lock()
+        self.n_decided = 0
+        self._ring = []
+        self._slow = []
+
+    def bump(self, k):
+        self.n_decided += k            # bare cross-lane counter bump
+
+    def push(self, x):
+        self._ring.append(x)           # unlocked mutator call
+
+    def note(self, x):
+        heapq.heappush(self._slow, x)  # unlocked heap mutation
+
+    def rebind(self):
+        self._ring = []                # unlocked rebinding

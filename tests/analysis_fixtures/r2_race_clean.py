@@ -1,0 +1,27 @@
+"""Clean twin of r2_race_bad: every mutation under the lock."""
+
+import heapq
+import threading
+
+
+class Node:
+    def __init__(self):
+        self._stat_lock = threading.Lock()
+        self.n_decided = 0
+        self._ring = []
+        self._slow = []
+
+    def bump(self, k):
+        with self._stat_lock:
+            self.n_decided += k
+
+    def push(self, x):
+        with self._stat_lock:
+            self._ring.append(x)
+
+    def note(self, x):
+        with self._stat_lock:
+            heapq.heappush(self._slow, x)
+
+    def read(self):
+        return self.n_decided          # unlocked reads are fine

@@ -1,0 +1,17 @@
+"""Forged R3 violations: lazy-init hazard and dead fallback."""
+
+
+class Box:
+    def __init__(self):
+        self.ready = True
+
+    def poke(self):
+        if not hasattr(self, "cache"):          # lazy-init hazard
+            self.cache = {}
+        return self.cache
+
+    def peek(self, now):
+        return getattr(self, "stamp", now)      # lazy-init hazard
+
+    def dead(self):
+        return getattr(self, "ready", False)    # dead fallback

@@ -1,0 +1,22 @@
+"""Clean twin of r3_lazy_bad: everything eagerly initialized."""
+
+
+class Box:
+    def __init__(self, now):
+        self.ready = True
+        self.cache = {}
+        self.stamp = now
+
+    def poke(self):
+        return self.cache
+
+    def peek(self):
+        return self.stamp
+
+    def alive(self):
+        return self.ready
+
+    def __del__(self):
+        # partially-constructed objects legitimately probe here
+        h = getattr(self, "cache", None)
+        return h

@@ -1,0 +1,14 @@
+"""Forged R4 violation: the PR 5 `sel` bug shape — a parameter
+clobbered by an unrelated temp inside a nested block, then consumed
+after the block."""
+
+import numpy as np
+
+
+def rep_post(gkeys, sel, rows, enabled):
+    emitted = []
+    if enabled:
+        mask = rows > 0
+        sel = np.flatnonzero(mask)     # clobbers the lane-index param
+        emitted.append(int(mask.sum()))
+    return gkeys[sel], emitted          # reads the temp, not the arg

@@ -1,0 +1,32 @@
+"""Clean twin of r4_shadow_bad: distinct local name, plus the
+legitimate idioms the rule must NOT flag."""
+
+import numpy as np
+
+
+def rep_post(gkeys, sel, rows, enabled):
+    emitted = []
+    if enabled:
+        mask = rows > 0
+        picked = np.flatnonzero(mask)   # distinct name: fine
+        emitted.append(picked)
+    return gkeys[sel], emitted
+
+
+def narrowing(xs, keep):
+    if keep:
+        xs = xs[:keep]                  # RHS reads the old value
+    return sum(xs)
+
+
+def defaulting(limit=None):
+    if limit is None:
+        limit = 16                      # condition mentions the name
+    return limit
+
+
+def consumed_first(items, soas):
+    if len(items) > 2:
+        kept = [s for s in soas if s]   # old value consumed first...
+        soas = tuple(kept)              # ...then replaced: fine
+    return items, soas

@@ -1,0 +1,24 @@
+"""Forged R5 violations: work before the gate; logging in a lean
+path; a registered path whose gate vanished."""
+
+log = None
+
+
+class Hot:
+    enabled = False
+
+    @classmethod
+    def record(cls, req, kind):
+        info = {"req": req, "kind": kind}    # dict built pre-gate
+        tag = f"{kind}:{req}"                # f-string pre-gate
+        if not cls.enabled:
+            return
+        cls._ring = (info, tag)
+
+    def push(self, frames):
+        log.debug("pushing %d frames", len(frames))   # lean: no logs
+        return list(frames)
+
+    @classmethod
+    def gateless(cls, req):
+        return {"req": req}                  # gate deleted entirely

@@ -1,0 +1,25 @@
+"""Clean twin of r5_hotpath_bad: one attribute check when disabled;
+the lean path allocates but never narrates."""
+
+
+class Hot:
+    enabled = False
+
+    @classmethod
+    def record(cls, req, kind):
+        if not cls.enabled:
+            return
+        info = {"req": req, "kind": kind}    # after the gate: fine
+        cls._ring = (info, f"{kind}:{req}")
+
+    def push(self, frames):
+        out = []
+        for f in frames:                     # allocation is its job
+            out.append(bytes(f))
+        return out
+
+    @classmethod
+    def gateless(cls, req):
+        if not cls.enabled:
+            return None
+        return {"req": req}

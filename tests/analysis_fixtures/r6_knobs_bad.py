@@ -1,0 +1,20 @@
+"""Forged R6 violations: stale knob, undeclared ref, undocumented
+knob, chaos-family knob with no conftest reset (the test passes a
+conftest_src that lacks ChaosPlane.reset())."""
+
+
+class ConfigKey:
+    pass
+
+
+class PC(ConfigKey):
+    STALE_KNOB = 1       # declared, never read anywhere
+    UNDOC_KNOB = 2       # read, but absent from the doc text
+    CHAOS_X = 0          # family knob: needs ChaosPlane.reset()
+
+
+def boot():
+    a = PC.UNDOC_KNOB
+    b = PC.CHAOS_X
+    c = PC.TYPO_KNOB     # not a declared member
+    return a, b, c

@@ -1,0 +1,15 @@
+"""Clean twin of r6_knobs_bad: every knob read, documented, and
+family-reset in the conftest the test passes in."""
+
+
+class ConfigKey:
+    pass
+
+
+class PC(ConfigKey):
+    GOOD_KNOB = 1
+    CHAOS_X = 0
+
+
+def boot():
+    return PC.GOOD_KNOB, PC.CHAOS_X

@@ -1,0 +1,27 @@
+"""Forged R7 violations: side effects inside traced bodies."""
+
+import jax
+import jax.numpy as jnp
+
+TRACE = []
+
+
+def bad_step(state, x):
+    TRACE.append(x)            # captured container mutation
+    print("tracing", x)        # trace-time-only output
+    state.count = 1            # host attribute store
+    return state
+
+
+bad = jax.jit(bad_step, donate_argnums=0)
+
+
+def bad_branch(x):
+    def hot(v):
+        global TRACE           # global escape from a branch
+        return v + 1
+
+    def cold(v):
+        return v - 1
+
+    return jax.lax.cond(x > 0, hot, cold, x)

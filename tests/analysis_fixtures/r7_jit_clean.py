@@ -1,0 +1,21 @@
+"""Clean twin of r7_jit_bad: trace-time mutation of FRESH locals is
+fine (the storm kernel builds its replica list this way)."""
+
+import jax
+import jax.numpy as jnp
+
+
+def good_step(states, x):
+    outs = []
+    new_states = list(states)           # fresh local copy
+    for r in range(3):
+        outs.append(x + r)              # local list: fine
+        new_states[r] = x * r           # local store: fine
+    return tuple(new_states), jnp.stack(outs)
+
+
+good = jax.jit(good_step, donate_argnums=0)
+
+
+def good_branch(x):
+    return jax.lax.cond(x > 0, lambda v: v + 1, lambda v: v - 1, x)

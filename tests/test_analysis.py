@@ -1,0 +1,244 @@
+"""Static-analysis suite: tier-1 gate + per-rule teeth/precision.
+
+The gate test runs the full suite over ``gigapaxos_tpu/`` against the
+committed baseline and fails on any NEW finding — re-introducing the
+PR 5 ``sel`` shadowing bug or a bare lane-counter ``+=`` fails tier-1
+here.  The fixture tests prove every rule both fires on its forged
+bad sample (teeth) and stays quiet on the clean twin (precision).
+"""
+
+import time
+from pathlib import Path
+
+import pytest
+
+from gigapaxos_tpu.analysis import core
+from gigapaxos_tpu.analysis.decls import (Decls, HotPath,
+                                          ThreadedClass,
+                                          project_decls)
+
+REPO = Path(__file__).resolve().parents[1]
+FIXTURES = Path(__file__).parent / "analysis_fixtures"
+
+
+# ---------------------------------------------------------------------------
+# fixture harness
+
+
+def _fixture_ctx(name: str, decls: Decls, **overrides) -> core.Context:
+    sf = core.load_file(FIXTURES / name, REPO)
+    assert sf is not None, f"fixture {name} failed to parse"
+    return core.Context(files=[sf], decls=decls, root=REPO,
+                        **overrides)
+
+
+def _lock_decls() -> Decls:
+    return Decls(
+        threaded={"Node": ThreadedClass(
+            locks=frozenset({"_a", "_b", "_leaf", "_lanes"}),
+            rlocks=frozenset({"_lanes"}))},
+        lock_order=("Node._a", "Node._b"),
+        leaf_locks=frozenset({"Node._leaf"}),
+        indexed_locks={"Node._lanes": ("_locks_for",)},
+    )
+
+
+def _race_decls() -> Decls:
+    return Decls(threaded={"Node": ThreadedClass(
+        locks=frozenset({"_stat_lock"}),
+        guarded={"n_decided": "_stat_lock", "_ring": "_stat_lock",
+                 "_slow": "_stat_lock"})})
+
+
+def _hot_decls() -> Decls:
+    return Decls(hot_paths={
+        "Hot.record": HotPath("gate_first", gates=("enabled",)),
+        "Hot.push": HotPath("lean"),
+        "Hot.gateless": HotPath("gate_first", gates=("enabled",)),
+    })
+
+
+def _knob_decls() -> Decls:
+    return Decls(knob_families={"CHAOS_": "ChaosPlane.reset"})
+
+
+_KNOB_DOC_BAD = "STALE_KNOB CHAOS_X"       # UNDOC_KNOB missing
+_KNOB_DOC_CLEAN = "GOOD_KNOB CHAOS_X"
+_CONFTEST_BAD = "def _fix():\n    Config.clear()\n"
+_CONFTEST_CLEAN = ("def _fix():\n    Config.clear()\n"
+                   "    ChaosPlane.reset()\n")
+
+# (rule, bad fixture, clean fixture, decls factory,
+#  bad overrides, clean overrides)
+_CASES = [
+    ("lock-order", "r1_lock_order_bad.py", "r1_lock_order_clean.py",
+     _lock_decls, {}, {}),
+    ("race", "r2_race_bad.py", "r2_race_clean.py",
+     _race_decls, {}, {}),
+    ("lazy-init", "r3_lazy_bad.py", "r3_lazy_clean.py",
+     Decls, {}, {}),
+    ("shadow", "r4_shadow_bad.py", "r4_shadow_clean.py",
+     Decls, {}, {}),
+    ("hot-path", "r5_hotpath_bad.py", "r5_hotpath_clean.py",
+     _hot_decls, {}, {}),
+    ("knobs", "r6_knobs_bad.py", "r6_knobs_clean.py",
+     _knob_decls,
+     {"doc_text": _KNOB_DOC_BAD, "conftest_src": _CONFTEST_BAD},
+     {"doc_text": _KNOB_DOC_CLEAN, "conftest_src": _CONFTEST_CLEAN}),
+    ("jit-purity", "r7_jit_bad.py", "r7_jit_clean.py",
+     Decls, {}, {}),
+]
+
+
+@pytest.mark.parametrize(
+    "rule,bad,clean,mk,bad_over,clean_over", _CASES,
+    ids=[c[0] for c in _CASES])
+def test_rule_fires_on_forged_violation(rule, bad, clean, mk,
+                                        bad_over, clean_over):
+    ctx = _fixture_ctx(bad, mk(), **bad_over)
+    found = core.analyze(ctx, rules=[rule])
+    assert found, f"{rule} did not fire on {bad}"
+    assert all(f.rule == rule for f in found)
+
+
+@pytest.mark.parametrize(
+    "rule,bad,clean,mk,bad_over,clean_over", _CASES,
+    ids=[c[0] for c in _CASES])
+def test_rule_quiet_on_clean_twin(rule, bad, clean, mk, bad_over,
+                                  clean_over):
+    ctx = _fixture_ctx(clean, mk(), **clean_over)
+    found = core.analyze(ctx, rules=[rule])
+    assert not found, "false positives:\n" + "\n".join(
+        f.render() for f in found)
+
+
+def test_bad_fixture_finding_shapes():
+    """Spot-check the messages carry the triage context."""
+    ctx = _fixture_ctx("r1_lock_order_bad.py", _lock_decls())
+    msgs = "\n".join(f.message for f in core.analyze(
+        ctx, rules=["lock-order"]))
+    assert "declared order" in msgs
+    assert "cycle" in msgs
+    assert "leaf lock" in msgs
+    assert "sorted(...)" in msgs
+    assert "sorted()" in msgs  # the helper that forgot to sort
+
+
+def test_knob_bad_fixture_covers_all_four_leaks():
+    ctx = _fixture_ctx("r6_knobs_bad.py", _knob_decls(),
+                       doc_text=_KNOB_DOC_BAD,
+                       conftest_src=_CONFTEST_BAD)
+    msgs = "\n".join(f.message for f in core.analyze(
+        ctx, rules=["knobs"]))
+    assert "TYPO_KNOB" in msgs          # undeclared reference
+    assert "STALE_KNOB" in msgs         # declared, never read
+    assert "UNDOC_KNOB" in msgs         # not in the docs
+    assert "ChaosPlane.reset" in msgs   # family reset missing
+
+
+# ---------------------------------------------------------------------------
+# regression teeth: the historical bugs must fail the gate if
+# re-introduced, using the REAL project declarations
+
+
+def test_reintroduced_lane_counter_race_fails(tmp_path):
+    bad = tmp_path / "manager_like.py"
+    bad.write_text(
+        "import threading\n"
+        "class PaxosNode:\n"
+        "    def __init__(self):\n"
+        "        self._stat_lock = threading.Lock()\n"
+        "        self.n_decided = 0\n"
+        "    def _emit(self, newly):\n"
+        "        self.n_decided += int(newly.sum())\n")
+    sf = core.load_file(bad, tmp_path)
+    ctx = core.Context(files=[sf], decls=project_decls(),
+                       root=tmp_path)
+    found = core.analyze(ctx, rules=["race"])
+    assert any("n_decided" in f.message for f in found)
+
+
+def test_reintroduced_sel_shadowing_fails(tmp_path):
+    bad = tmp_path / "rep_post_like.py"
+    bad.write_text(
+        "import numpy as np\n"
+        "def _rep_post(self, gkeys, sel, rows, res):\n"
+        "    newly = np.asarray(res.newly_decided)\n"
+        "    if self.enabled:\n"
+        "        dreqs = np.asarray(res.req_lo)[newly]\n"
+        "        sel = np.flatnonzero(dreqs)\n"
+        "        self.record(dreqs[sel])\n"
+        "    self._emit_commits(rows[newly], gkeys[sel][newly])\n")
+    sf = core.load_file(bad, tmp_path)
+    ctx = core.Context(files=[sf], decls=project_decls(),
+                       root=tmp_path)
+    found = core.analyze(ctx, rules=["shadow"])
+    assert any(f.qualname == "_rep_post" and "'sel'" in f.message
+               for f in found)
+
+
+# ---------------------------------------------------------------------------
+# baseline mechanics
+
+
+def test_baseline_requires_why(tmp_path):
+    p = tmp_path / "b.json"
+    p.write_text('{"entries": [{"fingerprint": "x|y|z|w"}]}')
+    with pytest.raises(core.BaselineError):
+        core.load_baseline(p)
+
+
+def test_baseline_suppresses_only_matching(tmp_path):
+    f1 = core.Finding("race", "a.py", 10, "C.m", "msg",
+                      "self.n += 1")
+    f2 = core.Finding("race", "a.py", 20, "C.k", "msg",
+                      "self.m += 1")
+    baseline = {f1.fingerprint: "reviewed: single-writer"}
+    new, old, stale = core.split_baselined([f1, f2], baseline)
+    assert new == [f2] and old == [f1] and not stale
+
+
+def test_fingerprint_survives_line_drift():
+    a = core.Finding("race", "a.py", 10, "C.m", "msg",
+                     "self.n += 1")
+    b = core.Finding("race", "a.py", 99, "C.m", "msg",
+                     "self.n += 1")
+    assert a.fingerprint == b.fingerprint
+    c = core.Finding("race", "a.py", 10, "C.m", "msg",
+                     "self.n += 2")
+    assert a.fingerprint != c.fingerprint
+
+
+def test_stale_baseline_entries_reported():
+    f = core.Finding("race", "a.py", 1, "C.m", "msg", "x")
+    new, old, stale = core.split_baselined(
+        [], {f.fingerprint: "was fixed since"})
+    assert stale == [f.fingerprint]
+
+
+# ---------------------------------------------------------------------------
+# the tier-1 gate
+
+
+@pytest.mark.smoke
+def test_tree_clean_against_baseline():
+    t0 = time.monotonic()
+    ctx = core.build_context(REPO, project_decls())
+    findings = core.analyze(ctx)
+    bl_path = REPO / "ANALYSIS_BASELINE.json"
+    baseline = core.load_baseline(bl_path) if bl_path.is_file() \
+        else {}
+    new, _old, _stale = core.split_baselined(findings, baseline)
+    assert not new, (
+        "new static-analysis findings (fix them or baseline with a "
+        "'why'):\n" + "\n".join(f.render() for f in new))
+    assert time.monotonic() - t0 < 10.0, \
+        "analysis suite must stay fast enough for tier-1"
+
+
+def test_gate_scans_the_real_tree():
+    ctx = core.build_context(REPO, project_decls())
+    rels = {sf.rel for sf in ctx.files}
+    assert "gigapaxos_tpu/paxos/manager.py" in rels
+    assert "gigapaxos_tpu/net/transport.py" in rels
+    assert len(ctx.files) > 40
