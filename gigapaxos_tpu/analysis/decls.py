@@ -146,6 +146,18 @@ def project_decls() -> Decls:
             rlocks=frozenset({"_lock"}),
             guarded={"_layers": "_lock"},
         ),
+        # flight-recorder capture ring: the note_* hooks run on the
+        # intake/lane/logger threads while dump/snapshot run on
+        # trigger threads and the stats listener; the class-level
+        # _live registry is touched by node boot/stop and dump_all
+        "BlackboxRecorder": ThreadedClass(
+            locks=frozenset({"_lock", "_live_lock"}),
+            guarded={**{a: "_lock" for a in
+                        ("_ring", "_bytes", "n_records", "n_evicted",
+                         "n_dumps", "_last_trigger", "_churn_mark",
+                         "last_dump")},
+                     "_live": "_live_lock"},
+        ),
     }
     hot_paths = {
         # peer send entry: every frame crosses this
@@ -183,6 +195,15 @@ def project_decls() -> Decls:
         # the wave's submit half IS the constructor
         "EngineWave.__init__": HotPath("lean"),
         "EngineWave.collect": HotPath("lean"),
+        # flight-recorder capture hooks: every call site gates on
+        # `self.blackbox is not None` (one attribute check when off),
+        # so the bodies just have to stay lean
+        "BlackboxRecorder.note_frames": HotPath("lean"),
+        "BlackboxRecorder.note_wave": HotPath("lean"),
+        "BlackboxRecorder.note_wal": HotPath("lean"),
+        "BlackboxRecorder.note_tick": HotPath("lean"),
+        "BlackboxRecorder.note_ingress": HotPath("lean"),
+        "BlackboxRecorder._append": HotPath("lean"),
     }
     return Decls(
         threaded=threaded,
@@ -196,6 +217,7 @@ def project_decls() -> Decls:
             "PaxosNode._stat_lock", "Transport._rtt_lock",
             "DelayProfiler._lock", "RequestInstrumenter._lock",
             "ChaosPlane._lock", "Config._lock",
+            "BlackboxRecorder._lock", "BlackboxRecorder._live_lock",
         }),
         indexed_locks={
             "PaxosNode._engine_locks": ("_locks_for",),
@@ -205,6 +227,7 @@ def project_decls() -> Decls:
                       "PaxosNode._engine_locks"},
         knob_families={
             "CHAOS_": "ChaosPlane.reset",
+            "BLACKBOX_": "BlackboxRecorder.reset",
             "TRACE_": "RequestInstrumenter.reset",
             "SLOW_TRACE_": "RequestInstrumenter.reset",
             "PROFILE_": "DelayProfiler.clear",

@@ -188,3 +188,20 @@ async def churn_settled(peers: Dict[int, Tuple[str, int]],
                 errs.append(f"ballot churn still moving: {a} -> {b} "
                             f"over {window_s}s")
             return False, errs
+
+
+def capture_on_violation(violations: List[str]) -> List[str]:
+    """Flight-recorder hookup: when a scenario's invariant checks
+    failed, snapshot every live node's black-box ring so the violating
+    history can be re-driven offline (``python -m gigapaxos_tpu.blackbox
+    replay``).  Returns the dump paths — empty when nothing violated or
+    no recorder is armed (``PC.BLACKBOX_MB`` 0).  The scenario runner
+    attaches them to the failing row in ``CHAOS_*.json``."""
+    if not violations:
+        return []
+    from gigapaxos_tpu.blackbox.recorder import BlackboxRecorder
+    paths = BlackboxRecorder.dump_all("invariant_violation")
+    if paths:
+        log.warning("invariant violation: dumped %d flight-recorder "
+                    "capture(s): %s", len(paths), paths)
+    return paths

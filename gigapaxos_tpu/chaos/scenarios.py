@@ -398,6 +398,13 @@ def run_scenario(name: str, seed: int = 1,
         out = asyncio.run(body())
         row.update(out)
         row["ok"] = all(row["invariants"].values())
+        if not row["ok"]:
+            # failing rows carry their flight-recorder captures (when
+            # PC.BLACKBOX_MB armed the rings) — the offline repro.
+            # Inside the try: emu.stop() deregisters the recorders.
+            paths = inv.capture_on_violation(row["violations"])
+            if paths:
+                row["blackbox"] = paths
     finally:
         snap = ChaosPlane.snapshot()
         try:

@@ -1,5 +1,5 @@
 """Tiny per-node stats listener: GET /metrics | /stats | /healthz |
-/groups | /groups/<id> | /traces/<trace_id>.
+/groups | /groups/<id> | /traces/<trace_id> | /blackbox[/dump].
 
 Every server process becomes scrapeable without the full HTTP gateway:
 a dependency-free asyncio HTTP/1.0-style responder living on the node's
@@ -42,7 +42,8 @@ def _json_resp(obj) -> Tuple[str, str, bytes]:
 
 
 def observability_routes(path: str, groups_fn: Optional[Callable] = None,
-                         group_fn: Optional[Callable] = None):
+                         group_fn: Optional[Callable] = None,
+                         blackbox=None):
     """Shared GET route bodies for the introspection endpoints (the
     per-node listener and the HTTP gateway serve identical content):
 
@@ -50,6 +51,10 @@ def observability_routes(path: str, groups_fn: Optional[Callable] = None,
     - ``/groups/<name|gkey>`` -> ``group_fn(ident)`` detail (404 None)
     - ``/traces/<trace_id>``  -> this process's trace export + its
       local breakdown (the cluster stitch input)
+    - ``/blackbox``           -> flight-recorder ring state
+      (``{"enabled": false}`` when ``PC.BLACKBOX_MB`` is 0)
+    - ``/blackbox/dump``      -> snapshot the ring to a ``.gpbb``
+      capture now; answers with its path
 
     Returns ``(status, content_type, body)`` or None (no match).
     """
@@ -78,6 +83,15 @@ def observability_routes(path: str, groups_fn: Optional[Callable] = None,
         ex = RequestInstrumenter.export_trace(tid)
         ex["breakdown"] = RequestInstrumenter.cluster_breakdown(tid, [ex])
         return _json_resp(ex)
+    if path == "/blackbox":
+        if blackbox is None:
+            return _json_resp({"enabled": False})
+        return _json_resp(blackbox.snapshot())
+    if path == "/blackbox/dump":
+        if blackbox is None:
+            return ("409 Conflict", "application/json",
+                    b'{"err":"blackbox disabled (PC.BLACKBOX_MB=0)"}')
+        return _json_resp({"dumped": blackbox.dump("http")})
     if path == "/chaos" or path.startswith("/chaos/"):
         # runtime control + state of the fault plane (chaos/faults.py);
         # the original path (with query) is re-joined for the verbs

@@ -123,6 +123,11 @@ class Transport:
         self.test_drop_rate = 0.0
         self._drop_rng = None
 
+        # flight recorder (set by the owning node after construction;
+        # single-writer at boot like the drop knobs above): when armed,
+        # the scan loop notes per-chunk ingress frame/byte counts
+        self.blackbox = None
+
         # NIOInstrumenter analog.  dropped_frames stays the total;
         # the per-cause split lets the metrics plane tell flaky links
         # (peer_gone/write_error + reconnects) from backpressure
@@ -405,6 +410,9 @@ class Transport:
                 del mv
                 self.rcvd_frames += len(frames)
                 self.rcvd_bytes += consumed
+                bb = self.blackbox
+                if bb is not None:
+                    bb.note_ingress(len(frames), consumed)
                 if self.on_frames is not None:
                     try:
                         self.on_frames(frames)

@@ -119,6 +119,10 @@ class PaxosLogger:
                            for _ in range(self.segments)]
         self._q: "queue.Queue" = queue.Queue()
         self._closed = False
+        # flight recorder (set by the owning node after construction,
+        # boot-path single-writer): when armed, inline WAL appends note
+        # their post-write segment offsets into the capture ring
+        self.blackbox = None
         self._writer = threading.Thread(target=self._writer_loop,
                                         daemon=True, name="gp-wal")
         self._writer.start()
@@ -223,8 +227,13 @@ class PaxosLogger:
             wal.flush()
             if self.sync if fsync is None else fsync:
                 os.fsync(wal.fileno())
-            over = wal.tell() >= self.compact_threshold
+            off = wal.tell()
+            over = off >= self.compact_threshold
         RequestInstrumenter.span_end(sp)
+        bb = self.blackbox
+        if bb is not None:
+            bb.note_wal(RequestInstrumenter.current_wave(), seg, off,
+                        n_entries)
         DelayProfiler.update_delay("wal.fsync", t0)
         if self.segments > 1:
             # per-segment tail next to the node-wide one: lane skew

@@ -258,6 +258,10 @@ class _MassElections:
 class PaxosNode:
     """One replica node (server)."""
 
+    # class-level default so partially built instances (tests drive
+    # _decode_batch on a bare __new__ instance) read the plane as off
+    blackbox = None
+
     def __init__(self, node_id: int, addr_map: Dict[int, Tuple[str, int]],
                  app: Replicable, logdir: str,
                  backend: Optional[str] = None,
@@ -481,6 +485,9 @@ class PaxosNode:
         # (scenario runner, /chaos route) survives node constructions
         from gigapaxos_tpu.chaos.faults import ChaosPlane
         ChaosPlane.configure_from_pc()
+        # stashed for the flight recorder's wave hook (chaos fault
+        # verdicts ride the W records when the plane is on)
+        self._chaos = ChaosPlane
         # failure detection (ref: gigapaxos/FailureDetection.java)
         self._last_heard: Dict[int, float] = {}
         self.ping_interval = float(Config.get(PC.PING_INTERVAL_S))
@@ -505,6 +512,24 @@ class PaxosNode:
         self.transport = Transport(
             node_id, addr_map[node_id], addr_map, self._on_frame,
             on_frames=self._on_frames)
+        # flight recorder (PC.BLACKBOX_*; gigapaxos_tpu/blackbox/):
+        # the per-node capture ring, armed at construction so every
+        # hook site (decode boundary, engine wave, WAL append,
+        # transport scan) pays exactly one attribute check when off.
+        # The engine-shape knobs are stashed for the dump manifest —
+        # offline replay must rebuild this exact engine.
+        self._bb_knobs = {"backend": bk, "capacity": cap, "window": win}
+        self.blackbox = None
+        bb_mb = int(Config.get(PC.BLACKBOX_MB))
+        if bb_mb > 0:
+            from gigapaxos_tpu.blackbox.recorder import BlackboxRecorder
+            self.blackbox = BlackboxRecorder(
+                node_id, logdir, max_bytes=bb_mb << 20,
+                max_age_s=float(Config.get(PC.BLACKBOX_S)),
+                dump_on_slow=bool(Config.get(PC.BLACKBOX_ON_SLOW)),
+                manifest_fn=self._blackbox_manifest)
+        self.transport.blackbox = self.blackbox
+        self.logger.blackbox = self.blackbox
         self._loop_thread: Optional[threading.Thread] = None
         self._worker_thread: Optional[threading.Thread] = None
         self._loop = None
@@ -624,6 +649,21 @@ class PaxosNode:
         single-lane nodes and non-lane threads)."""
         return getattr(self._wtls, "wal_seg", 0)
 
+    def _now(self) -> float:
+        """The engine clock: every time-driven consensus decision
+        (redrive, election backoff, failure detection, parked/idle
+        sweeps) and every stamp those decisions later compare against
+        reads THIS, not ``time.time()``.  Worker loops pin it per wave
+        to the batch's decode timestamp — the value the flight
+        recorder's F record carries — and ticks run it unpinned (real
+        time, captured in the T record), so offline replay re-pins the
+        captured values and reproduces each decision bit-for-bit.
+        Unpinned threads (event loop, control plane) get real time.
+        Measurement-only reads (profiler spans, latency accounting,
+        wall-clock sleep budgets) stay on ``time.time()``."""
+        now = getattr(self._wtls, "now", 0.0)
+        return now if now else time.time()
+
     def _locks_for(self, shards) -> list:
         """The engine locks a multi-shard lifecycle call must hold,
         acquired in index order (lanes only ever hold their own lock,
@@ -700,6 +740,10 @@ class PaxosNode:
             self._loop.call_soon_threadsafe(self._ping_task.cancel)
             self._loop.call_soon_threadsafe(self._loop.stop)
             self._loop_thread.join(5)
+        if self.blackbox is not None:
+            # deregister from the live set: a stopped node must not
+            # receive later dump_all() triggers (its engine is gone)
+            self.blackbox.close()
         self.logger.close(discard=abort)
 
     @property
@@ -763,7 +807,9 @@ class PaxosNode:
             raise
         if not metas:
             return 0
-        self._install_rows(metas, self_coord=True, now=time.time())
+        # _now(): unpinned control threads get real time; replay pins
+        # the capture's clock so create-time _la stamps are capture-era
+        self._install_rows(metas, self_coord=True, now=self._now())
         if initial_state:
             for meta in metas:
                 self.app.restore(meta.name, initial_state)
@@ -897,7 +943,7 @@ class PaxosNode:
         self._catchup_barrier.pop(row, None)
 
     def _touch(self, row: int) -> None:
-        self._la[row] = time.time()
+        self._la[row] = self._now()
 
     def _sweep_idle(self, now: float, shard: int = 0) -> int:
         """One deactivator sweep: pause up to pause_max_per_tick rows
@@ -988,7 +1034,7 @@ class PaxosNode:
             # rows before the client's retransmit lands.
             log.warning("unpause of %r deferred: row capacity exhausted",
                         d["name"])
-            self._sweep_idle(time.time(), self._wal_seg())
+            self._sweep_idle(self._now(), self._wal_seg())
             return None
         except ValueError:
             # 64-bit group-key collision with a live group: permanent —
@@ -1012,7 +1058,7 @@ class PaxosNode:
             self.n_unpaused += 1
         # the coordinator may have died while this group was cold — the
         # dead-node scan only covers hydrated rows, so re-check here
-        now = time.time()
+        now = self._now()
         _num, coord = unpack_ballot(int(self._bal[meta.row]))
         if coord >= 0 and coord != self.id and coord in self.addr_map:
             last = self._last_heard.get(coord, self._boot_ts)
@@ -1060,6 +1106,13 @@ class PaxosNode:
         parser in one C call; everything else decodes per frame."""
         out = []
         req_frames: List[bytes] = []
+        # flight recorder: the decode boundary is where the capture
+        # sees EVERY packet the engine will consume — wire frames by
+        # reference (zero copy), self-routed objects re-encoded at
+        # their consumption point, so the F-record stream is a complete
+        # deterministic replay input with live batch boundaries
+        bb = self.blackbox
+        cap: Optional[List[bytes]] = [] if bb is not None else None
         for item in batch:
             if isinstance(item, list):
                 # chunk of frames (batch intake): flatten inline
@@ -1067,7 +1120,17 @@ class PaxosNode:
                 continue
             if not isinstance(item, (bytes, bytearray, memoryview)):
                 out.append(item)  # self-routed object
-            elif len(item) == 0:
+                if cap is not None:
+                    try:
+                        cap.append(item.encode())
+                    except Exception:
+                        log.exception(
+                            "blackbox: un-encodable self-routed %s",
+                            type(item).__name__)
+                continue
+            if cap is not None:
+                cap.append(item)
+            if len(item) == 0:
                 log.warning("dropping empty frame")
             elif item[0] == int(pkt.PacketType.REQUEST):
                 req_frames.append(item)
@@ -1094,6 +1157,12 @@ class PaxosNode:
                         out.append(pkt.decode(f))
                     except Exception:
                         log.exception("dropping malformed request frame")
+        if cap is not None and cap:
+            # the recorded ts IS this wave's pinned engine clock — the
+            # one value replay needs to reproduce time-driven decisions
+            bb.note_frames(self._now(),
+                           RequestInstrumenter.current_wave(),
+                           self._wal_seg(), cap)
         return out
 
     def _was_executed(self, rid: int) -> bool:
@@ -1245,6 +1314,12 @@ class PaxosNode:
             self._backlog_est = int(
                 self._inq.qsize() * n_frames / max(1, len(batch)))
             RequestInstrumenter.set_wave(RequestInstrumenter.next_wave())
+            # wave-coherent engine clock: the decode timestamp is what
+            # the flight recorder's F record carries, so every _now()
+            # read while processing this batch must return it — replay
+            # re-pins the captured value and time-driven decisions
+            # (redrive windows, election backoff) reproduce exactly
+            self._wtls.now = time.time()
             t0 = time.monotonic()
             c0 = self._ct()
             try:
@@ -1270,6 +1345,9 @@ class PaxosNode:
                 # else: crash-stop teardown races (closed DB / closed
                 # event loop) are the emulated crash, not errors
             DelayProfiler.update_delay("node.batch", t0, len(batch))
+            # ticks run UNPINNED (real time) — each effective tick's
+            # clock is captured in its own T record instead
+            self._wtls.now = 0.0
             with self._engine_lock:
                 self._tick()
 
@@ -1333,8 +1411,11 @@ class PaxosNode:
                     continue
                 if item is None:
                     return
-                wid, decoded = item
+                wid, ts, decoded = item
                 RequestInstrumenter.set_wave(wid)
+                # pin the engine clock to the batch's decode timestamp
+                # (the F record's ts) for the whole _process pass
+                self._wtls.now = ts
                 t0 = time.monotonic()
                 sp = RequestInstrumenter.span_begin(
                     "engine", node=self.id, items=len(decoded))
@@ -1349,6 +1430,7 @@ class PaxosNode:
                 DelayProfiler.update_total("w.process", t0, len(decoded))
                 DelayProfiler.update_delay("node.batch", t0,
                                            len(decoded))
+                self._wtls.now = 0.0  # ticks run unpinned (T records)
                 with self._engine_lock:
                     self._tick()
 
@@ -1390,6 +1472,10 @@ class PaxosNode:
                 # trace events recorded while processing it) join up
                 wid = RequestInstrumenter.next_wave()
                 RequestInstrumenter.set_wave(wid)
+                # decode timestamp rides down the pipeline with the
+                # batch: the process stage pins the engine clock to it
+                ts = time.time()
+                self._wtls.now = ts
                 t0 = time.monotonic()
                 sp = RequestInstrumenter.span_begin(
                     "decode", node=self.id, frames=n_frames)
@@ -1403,7 +1489,7 @@ class PaxosNode:
                 DelayProfiler.update_total("w.decode", t0, len(batch))
                 t0 = time.monotonic()
                 # blocks at depth 2: backpressure
-                stage.put((wid, decoded))
+                stage.put((wid, ts, decoded))
                 DelayProfiler.update_total("w.decode_blocked", t0)
         finally:
             stage.put(None)
@@ -1529,8 +1615,11 @@ class PaxosNode:
                 if item is None:
                     emitq.put(None)  # FIFO: drains after our last batch
                     return
-                wid, decoded = item
+                wid, ts, decoded = item
                 RequestInstrumenter.set_wave(wid)
+                # pin the engine clock to the batch's decode timestamp
+                # (the F record's ts) for the whole _process pass
+                self._wtls.now = ts
                 t0 = time.monotonic()
                 sp = RequestInstrumenter.span_begin(
                     "engine", node=self.id, items=len(decoded),
@@ -1549,6 +1638,7 @@ class PaxosNode:
                                            len(decoded))
                 DelayProfiler.update_delay("node.batch", t0,
                                            len(decoded))
+                self._wtls.now = 0.0  # ticks run unpinned (T records)
                 with lock:
                     self._tick(k)
 
@@ -1592,6 +1682,11 @@ class PaxosNode:
                     self._inq.qsize() * n_frames / max(1, len(batch)))
                 wid = RequestInstrumenter.next_wave()
                 RequestInstrumenter.set_wave(wid)
+                # decode timestamp rides to every lane with its
+                # sub-batch: each proc thread pins its engine clock to
+                # it, so one wave shares one clock across all lanes
+                ts = time.time()
+                self._wtls.now = ts
                 t0 = time.monotonic()
                 sp = RequestInstrumenter.span_begin(
                     "decode", node=self.id, frames=n_frames)
@@ -1612,7 +1707,7 @@ class PaxosNode:
                     if lanes[k]:
                         # blocking at depth 4: backpressure reaches the
                         # socket exactly as the single lane's did
-                        procqs[k].put((wid, lanes[k]))
+                        procqs[k].put((wid, ts, lanes[k]))
                 DelayProfiler.update_total("w.decode_blocked", t0)
         finally:
             for q in procqs:
@@ -1641,10 +1736,17 @@ class PaxosNode:
         return rows[rows % self.shards == shard]
 
     def _tick_inner(self, shard: int) -> None:
-        now = time.time()
+        now = self._now()
         if self._last_ticks[shard] + self.ping_interval > now:
             return
         self._last_ticks[shard] = now
+        # flight recorder: effective ticks are part of the replay input
+        # — failure detection, elections, and redrives below are all
+        # time-driven, so replay must re-run each one at the captured
+        # stream position with the captured clock
+        bb = self.blackbox
+        if bb is not None:
+            bb.note_tick(now, RequestInstrumenter.current_wave(), shard)
         S = self.shards
         if shard == 0:
             for fn in self._tick_hooks:
@@ -1871,6 +1973,14 @@ class PaxosNode:
     # -- batch processing ----------------------------------------------
 
     def _process(self, batch: List) -> None:
+        # flight recorder: bracket the wave with order-sensitive lane
+        # digests — replay's per-wave ground truth.  Lane-pure (this
+        # thread's shard only): other lanes mutate their rows
+        # concurrently and must not perturb the digest.
+        bb = self.blackbox
+        if bb is not None:
+            bb_lane = self._wal_seg()
+            bb_pre = self._bb_digest(bb_lane)
         self._resp_out: Optional[Dict] = {}
         self._out_buf: Optional[List] = []
         self._self_buf: Optional[List] = []
@@ -1905,6 +2015,76 @@ class PaxosNode:
                 sp = RequestInstrumenter.span_begin("emit", node=self.id)
                 self._emit_bundle(resp, out)
                 RequestInstrumenter.span_end(sp)
+            if bb is not None:
+                ch = None
+                if self._chaos.enabled:
+                    ch = [self._chaos.n_dropped, self._chaos.n_blocked,
+                          self._chaos.n_delayed, self._chaos.n_reordered]
+                bb.note_wave(RequestInstrumenter.current_wave(),
+                             bb_lane, len(batch), bb_pre,
+                             self._bb_digest(bb_lane), ch)
+
+    def _bb_digest(self, lane: int) -> int:
+        """Order-sensitive digest of THIS lane's host-mirror state
+        (gkey, exec cursor, max promised ballot per row) for the flight
+        recorder's per-wave W records.  Strided to the lane's rows
+        (row % S == lane) so concurrent lanes never read each other's
+        rows mid-wave; uint64 multiply-xor fold, deterministic across
+        runs and platforms."""
+        S = self.shards
+        gk = self._row_gkey[lane::S]
+        if not len(gk):
+            return 0
+        h = gk * np.uint64(0x9E3779B97F4A7C15)
+        h ^= (self._cur[lane::S].astype(np.uint64)
+              * np.uint64(0xBF58476D1CE4E5B9))
+        h ^= (self._bal[lane::S].astype(np.uint64)
+              * np.uint64(0x94D049BB133111EB))
+        return int(np.bitwise_xor.reduce(h))
+
+    def _blackbox_manifest(self, reason: str) -> dict:
+        """Ground truth appended to a flight-recorder dump: the engine
+        shape replay must rebuild, the group table, and per-group final
+        state (host + device cursors, app digest/count).  Called on the
+        dump thread; the device gather runs under the engine locks."""
+        metas = sorted(self.table.snapshot_metas(), key=lambda m: m.row)
+        rows = np.asarray([m.row for m in metas], np.int64)
+        dev = self._inspect_locked(rows) if len(rows) else {}
+        app_digest = getattr(self.app, "digest", None)
+        app_count = getattr(self.app, "count", None)
+        groups = []
+        for j, m in enumerate(metas):
+            g = {"name": m.name, "gkey": int(m.gkey), "row": int(m.row),
+                 "members": [int(x) for x in m.members],
+                 "version": int(m.version),
+                 "exec_cursor_host": int(self._cur[m.row])}
+            if dev:
+                g["exec_cursor"] = int(dev["exec_cursor"][j])
+                g["next_slot"] = int(dev["next_slot"][j])
+            if isinstance(app_digest, dict):
+                g["app_digest"] = int(app_digest.get(m.name, 0))
+            if isinstance(app_count, dict):
+                g["app_count"] = int(app_count.get(m.name, 0))
+            groups.append(g)
+        man = {
+            "app": type(self.app).__name__,
+            "addr_map": {str(k): [v[0], int(v[1])]
+                         for k, v in self.addr_map.items()},
+            "knobs": {**self._bb_knobs,
+                      "engine_shards": self.shards,
+                      "fuse_waves": "on" if self._fuse_waves else "off",
+                      "sync_wal": self.logger.sync},
+            "counters": {"executed": self.n_executed,
+                         "decided": self.n_decided,
+                         "ballot_changes": self.n_ballot_changes},
+            # replay restores this so failure detection's never-heard
+            # fallback (_last_heard.get(peer, _boot_ts)) reproduces
+            "boot_ts": self._boot_ts,
+            "groups": groups,
+        }
+        if self._chaos.enabled:
+            man["chaos"] = self._chaos.snapshot()
+        return man
 
     def _process_inner(self, batch: List) -> None:
         by_type: Dict[type, List] = {}
@@ -1914,7 +2094,7 @@ class PaxosNode:
             # (_ReqSoA carries a sender *array*; its senders are clients,
             # never peers, so liveness bookkeeping doesn't apply)
             if type(s) is int and s in self.addr_map:
-                self._last_heard[s] = time.time()
+                self._last_heard[s] = self._now()
                 self._suspects.discard(s)
 
         # cold control path first (creates must precede traffic to them)
@@ -2115,6 +2295,12 @@ class PaxosNode:
         np.add.at(self._bal_changes, rows, 1)
         with self._stat_lock:
             self.n_ballot_changes += len(rows)
+            total = self.n_ballot_changes
+        bb = self.blackbox
+        if bb is not None:
+            # churn-spike trigger (arXiv:2006.01885 leader-churn
+            # pathology): a burst of ballot changes dumps the ring
+            bb.note_churn(total)
 
     def metrics(self, include_profiler: bool = True) -> dict:
         """Structured node metrics: counters + engine overlap split +
@@ -2273,7 +2459,8 @@ class PaxosNode:
         """Introspection routes for the per-node stats listener."""
         from gigapaxos_tpu.net.statshttp import observability_routes
         return observability_routes(path, groups_fn=self.groups_info,
-                                    group_fn=self.group_info)
+                                    group_fn=self.group_info,
+                                    blackbox=self.blackbox)
 
     def stats(self) -> str:
         """One-line node counters (ref: the reference's periodic
@@ -2308,7 +2495,7 @@ class PaxosNode:
                 self.n_park_dropped += 1
         with self._stat_lock:
             self.n_parked += 1
-        q.append((time.time(), prop))
+        q.append((self._now(), prop))
 
     def _flush_parked(self, row: int) -> None:
         """Re-inject parked proposals now that leadership settled (we won,
@@ -2317,14 +2504,14 @@ class PaxosNode:
         q = self._parked.pop(row, None)
         if not q:
             return
-        now = time.time()
+        now = self._now()
         live = [p for ts, p in q if now - ts < 10.0]
         if live:
             self._handle_requests([], live)
 
     def _intake_take(self, n: int = 1) -> bool:
         """Take n tokens from the intake bucket; False = throttled."""
-        now = time.time()
+        now = self._now()
         self._intake_tokens = min(
             self.intake_rps,
             self._intake_tokens + (now - self._intake_ts) *
@@ -2339,7 +2526,7 @@ class PaxosNode:
         """Token-bucket intake limiter (ref: paxosutil/RateLimiter):
         admits up to the bucket's tokens, answers the rest status 1
         ("not now, retry") so clients back off instead of queueing."""
-        now = time.time()
+        now = self._now()
         self._intake_tokens = min(
             self.intake_rps,
             self._intake_tokens + (now - self._intake_ts) *
@@ -2423,7 +2610,7 @@ class PaxosNode:
         req_parts: List[np.ndarray] = []
         flag_parts: List[int] = []
         pay_parts: List[bytes] = []
-        now = time.time()
+        now = self._now()
         ex, exo = self._executed_recent, self._executed_old
         # ---- vectorized client batches (the hot path: one _ReqSoA per
         # wire read; per-lane Python is 3-4 dict ops) ----
@@ -2518,7 +2705,7 @@ class PaxosNode:
                 self._route(o.sender, pkt.Response(
                     self.id, o.gkey, o.req_id, 3, b""))
                 continue
-            self._client_wait[o.req_id] = (o.sender, time.time(), o.gkey)
+            self._client_wait[o.req_id] = (o.sender, self._now(), o.gkey)
             coord = unpack_ballot(int(self._bal[meta.row]))[1]
             if coord != self.id:
                 prop = pkt.Proposal(
@@ -2589,14 +2776,14 @@ class PaxosNode:
                     # view repair by running for coordinator ourselves —
                     # nothing else breaks a stable standoff on an
                     # otherwise idle row
-                    t = time.time()
+                    t = self._now()
                     if t - self._bounced.get(o.req_id, 0.0) < 10.0:
                         self._start_election(meta.row, meta)
                     else:
                         self._bounced[o.req_id] = t
                     self._park(meta.row, o)
                 else:
-                    t = time.time()
+                    t = self._now()
                     if t - self._bounced.get(o.req_id, 0.0) < 5.0:
                         self._park(meta.row, o)
                     else:
@@ -2613,7 +2800,7 @@ class PaxosNode:
                 # execution answers it (a carryover-registered rid has
                 # no waiter here)
                 self._store_payload(o.req_id, o.flags, o.payload)
-                self._client_wait[o.req_id] = (o.entry, time.time(),
+                self._client_wait[o.req_id] = (o.entry, self._now(),
                                                o.gkey)
                 continue
             if meta.row in self._catchup_barrier:
@@ -2841,7 +3028,7 @@ class PaxosNode:
                                                 np.int32))
         rows_all = self._rows_for_keys(gkeys)
         if self._fused is not None:
-            now = time.time()
+            now = self._now()
             keep, acked_m, stale_m, ow_m, reply_bal = \
                 self._fused.handle_accepts(
                     rows_all, slots_all, bals_all, reqs_all, now,
@@ -2914,7 +3101,7 @@ class PaxosNode:
             return None
         idxs = np.flatnonzero(keep)
         rows = rows_all[idxs]
-        now = time.time()
+        now = self._now()
         self._la[rows] = now
         return (idxs, rows, slots_all[idxs], bals_all[idxs],
                 reqs_all[idxs], send_all[idxs], now)
@@ -2998,7 +3185,7 @@ class PaxosNode:
         c_bals = _cat(commits, lambda o: np.asarray(o.bal, np.int32))
         c_reqs = _cat(commits, lambda o: _merge_req(o.req_lo, o.req_hi))
         cpre = self._commit_pre(self._rows_for_keys(c_gkeys), c_slots,
-                                c_bals, c_reqs, time.time())
+                                c_bals, c_reqs, self._now())
         return a_gkeys, apre, c_gkeys, cpre
 
     def _handle_accepts_commits(self, accepts: List,
@@ -3227,7 +3414,7 @@ class PaxosNode:
         if not len(ii):
             return
         reqs = _merge_req(np.asarray(res.req_lo), np.asarray(res.req_hi))
-        self._la[rows[ii]] = time.time()
+        self._la[rows[ii]] = self._now()
         self.logger.log_raw_inline(native.encode_wal(
             np.full(len(ii), REC_DECIDE, np.uint8), gkeys[ii],
             slots[ii], np.zeros(len(ii), np.int32), reqs[ii], []),
@@ -3255,7 +3442,7 @@ class PaxosNode:
         dedupe, apply, WAL, execute newly contiguous decisions, and sync
         on out-of-window lanes.  Fused C path when the native engine is
         active; numpy + backend SPI otherwise."""
-        now = time.time()
+        now = self._now()
         if self._fused is not None:
             applied, stale_m, ow_m, ex_rows, ex_slots, ex_reqs = \
                 self._fused.handle_commits(rows, slots, bals, req_ids,
@@ -3423,9 +3610,17 @@ class PaxosNode:
                 if RequestInstrumenter.enabled:
                     # request done end-to-end at the answering node:
                     # feed the slow-request log (waiter[1] = intake ts)
+                    total_s = time.time() - waiter[1]
                     RequestInstrumenter.note_done(
-                        req_id, time.time() - waiter[1],
+                        req_id, total_s,
                         force=bool(flags & FLAG_SAMPLED))
+                    bb = self.blackbox
+                    if bb is not None and bb.dump_on_slow and \
+                            0 < RequestInstrumenter.slow_threshold_s \
+                            <= total_s:
+                        # PC.BLACKBOX_ON_SLOW: an SLO breach entering
+                        # the slow-request log snapshots the ring
+                        bb.trigger("slow_trace")
             cur += 1
         with self._stat_lock:
             self.n_executed += n_exec
@@ -3449,7 +3644,7 @@ class PaxosNode:
     # -- sync (gap fill; ref: SyncDecisionsPacket) ----------------------
 
     def _sync_if_gap(self, row: int) -> None:
-        now = time.time()
+        now = self._now()
         last = self._last_sync
         if last.get(row, 0) + 0.2 > now:
             return
@@ -3488,10 +3683,10 @@ class PaxosNode:
         key = (o.sender, o.xfer_id)
         parts = xfers.get(key)
         if parts is None:
-            parts = xfers[key] = [time.time(), o.nchunks,
+            parts = xfers[key] = [self._now(), o.nchunks,
                                   [None] * o.nchunks]
         if o.seq < parts[1] and parts[2][o.seq] is None:
-            parts[0] = time.time()  # refresh: transfer is alive (a slow
+            parts[0] = self._now()  # refresh: transfer is alive (a slow
             # link must not be GC'd mid-flight — only STALLED ones age)
             parts[2][o.seq] = o.data
             if all(p is not None for p in parts[2]):
@@ -3592,7 +3787,7 @@ class PaxosNode:
         self._last_heard.pop(node, None)
         self._suspects.add(node)
         log.info("node %d: peer %d suspected dead", self.id, node)
-        self._elect_rows_led_by(node, time.time())
+        self._elect_rows_led_by(node, self._now())
 
     def _elect_rows_led_by(self, dead: int, now: float) -> None:
         """Vectorized replacement for the per-meta scan (SURVEY §3.5:
@@ -3757,10 +3952,10 @@ class PaxosNode:
         el = self._elections.get(row)
         if el is None and self._mass_has(row):
             el = self._mass_to_dict(row)  # single path takes over
-        if el is not None and time.time() - el.started < 2.0:
+        if el is not None and self._now() - el.started < 2.0:
             return
         bal = pack_ballot(num + 1, self.id)
-        self._elections[row] = _Election(bal=bal, started=time.time())
+        self._elections[row] = _Election(bal=bal, started=self._now())
         for m in meta.members:
             self._route(m, pkt.Prepare(self.id, meta.gkey, bal))
 
@@ -4139,7 +4334,7 @@ class PaxosNode:
                         if f.row == row]:
             if rid in slot_of:
                 fl.slot, fl.bal = slot_of[rid], el.bal
-                fl.redriven = time.time()
+                fl.redriven = self._now()
             else:
                 self._proposed.pop(rid, None)
                 got = self._payload_get(rid)
@@ -4153,7 +4348,7 @@ class PaxosNode:
         # (observed in the torture test: a request accepted under the
         # dying coordinator arrived again via the parked queue and the
         # flush below re-proposed it beside its own carryover)
-        now_t = time.time()
+        now_t = self._now()
         for s, (b, rid, fl_, _pl) in carry.items():
             if not (fl_ & FLAG_NOOP) and rid not in self._proposed:
                 self._proposed[rid] = _InFlight(

@@ -174,3 +174,16 @@ class PC(ConfigKey):
     # boot-time partition spec "0,1|2": block both directions of every
     # edge crossing the sets (asymmetric edges: /chaos/block)
     CHAOS_PARTITION = ""
+    # flight recorder (gigapaxos_tpu/blackbox/): bounded always-on
+    # black-box of recent ingress frames + engine-wave digests + WAL
+    # offsets, dumped to blackbox-<node>-<ts>.gpbb on triggers (slow
+    # trace, chaos invariant violation, ballot-churn spike, SIGTERM/
+    # fatal exception, GET /blackbox/dump) and re-driven offline by
+    # `python -m gigapaxos_tpu.blackbox replay`.  Ring byte budget in
+    # MB; 0 = off (every hook then costs one attribute check)
+    BLACKBOX_MB = 0
+    # age horizon for ring records in seconds (0 = bytes-only bounding)
+    BLACKBOX_S = 30.0
+    # auto-dump when a sampled request enters the slow-request log
+    # (requires SLOW_TRACE_S > 0 and the trace plane enabled)
+    BLACKBOX_ON_SLOW = False

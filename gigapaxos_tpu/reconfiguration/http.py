@@ -20,11 +20,15 @@ Routes::
     GET  /groups            per-group consensus health (co-located node)
     GET  /groups/NAME       one group's health detail
     GET  /traces/ID         this process's share of one sampled trace
+    GET  /blackbox[/dump]   co-located node's flight-recorder state /
+                            snapshot its ring to a .gpbb capture
     GET  /cluster/metrics   ONE scrape point for the deployment: fan
                             out to every PC.STATS_PEERS node's /stats,
                             merge (histograms bucket-wise), render
     GET  /cluster/stats     the merged snapshot as JSON
     GET  /cluster/traces/ID cross-node stitched trace breakdown
+    GET  /cluster/blackbox[/dump]  flight-recorder fan-out: one call
+                            snapshots (or dumps) every node's ring
 
 Run standalone::
 
@@ -175,14 +179,16 @@ class HttpFrontend:
                 return metrics_response(
                     path, self.metrics_source or process_metrics)
             if method == "GET" and (path.startswith("/groups")
-                                    or path.startswith("/traces/")):
+                                    or path.startswith("/traces/")
+                                    or path.startswith("/blackbox")):
                 from gigapaxos_tpu.net.statshttp import \
                     observability_routes
                 node = self.obs_node
                 resp = observability_routes(
                     path,
                     groups_fn=node.groups_info if node else None,
-                    group_fn=node.group_info if node else None)
+                    group_fn=node.group_info if node else None,
+                    blackbox=getattr(node, "blackbox", None))
                 if resp is not None:
                     return resp
             if method == "GET" and path.startswith("/cluster/"):
@@ -263,6 +269,14 @@ class HttpFrontend:
             out = await cluster_trace(self.stats_peers, tid)
             return ("200 OK", "application/json",
                     json.dumps(out, default=str).encode())
+        if path in ("/cluster/blackbox", "/cluster/blackbox/dump"):
+            # flight-recorder fan-out: one call snapshots (or dumps)
+            # every node's ring — a coherent cross-node incident
+            sub = path[len("/cluster"):]
+            per_node = await scrape_cluster(self.stats_peers, sub)
+            return ("200 OK", "application/json",
+                    json.dumps({"nodes": per_node},
+                               default=str).encode())
         return None
 
 

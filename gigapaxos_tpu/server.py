@@ -153,6 +153,18 @@ def main(argv=None) -> int:
                    help='boot-time partition spec "0,1|2": block both '
                         "directions across the sets (or "
                         "CHAOS_PARTITION=; heal via GET /chaos/heal)")
+    p.add_argument("--blackbox-mb", type=int, default=None,
+                   help="flight-recorder ring byte budget in MB (0 = "
+                        "off, the default; or BLACKBOX_MB=); dumps "
+                        "blackbox-<node>-<ts>.gpbb on SLO/invariant/"
+                        "churn/crash triggers and GET /blackbox/dump")
+    p.add_argument("--blackbox-s", type=float, default=None,
+                   help="flight-recorder ring age horizon in seconds "
+                        "(0 = bytes-only bounding; or BLACKBOX_S=)")
+    p.add_argument("--blackbox-on-slow", action="store_true",
+                   help="auto-dump the ring when a sampled request "
+                        "enters the slow-request log (needs "
+                        "--slow-trace-ms; or BLACKBOX_ON_SLOW=)")
     args = p.parse_args(argv)
 
     extras = read_extras(args.config)
@@ -223,6 +235,25 @@ def main(argv=None) -> int:
             else (conv(extras[key.name]) if key.name in extras else None)
         if val is not None:
             Config.set(key, val)
+    # flight-recorder knobs (defaults off; the node arms its capture
+    # ring from these at construction — see gigapaxos_tpu/blackbox/)
+    for flag, key, conv in (
+            (args.blackbox_mb, PC.BLACKBOX_MB, int),
+            (args.blackbox_s, PC.BLACKBOX_S, float)):
+        val = flag if flag is not None \
+            else (conv(extras[key.name]) if key.name in extras else None)
+        if val is not None:
+            Config.set(key, val)
+    if args.blackbox_on_slow or \
+            extras.get("BLACKBOX_ON_SLOW", "").lower() in \
+            ("1", "true", "yes"):
+        Config.set(PC.BLACKBOX_ON_SLOW, True)
+    if int(Config.get(PC.BLACKBOX_MB)) > 0:
+        # the crash half of the SIGTERM/crash trigger pair: a fatal
+        # uncaught exception dumps every live ring before the process
+        # dies — the black box survives the incident it describes
+        from gigapaxos_tpu.blackbox.recorder import install_crash_hook
+        install_crash_hook()
 
     if args.paxos_only:
         # PaxosServer-style deployment: the engine without the control
@@ -278,6 +309,11 @@ def main(argv=None) -> int:
         stop.wait()
     finally:
         log.info("node %d stopping", args.id)
+        if int(Config.get(PC.BLACKBOX_MB)) > 0:
+            # SIGTERM trigger: snapshot before node.stop() deregisters
+            # the recorders (the dump manifest needs the live engine)
+            from gigapaxos_tpu.blackbox.recorder import BlackboxRecorder
+            BlackboxRecorder.dump_all("shutdown")
         if dumper is not None:
             dumper.stop()
         node.stop()

@@ -301,6 +301,7 @@ def render() -> str:
             "ceiling |")
 
     out.extend(_chaos_rows())
+    out.extend(_blackbox_rows())
     out.extend(_analysis_rows())
 
     out.append("")
@@ -351,6 +352,45 @@ def _chaos_rows():
             f"{'; '.join(parts) if parts else 'none'}; recovery "
             f"{r.get('recovery_s')} s; {r.get('acked')} acked ops, "
             f"{r.get('client_errors')} client timeouts |")
+    return out
+
+
+def _blackbox_rows():
+    """Replay-verification row from the newest tracked
+    ``BLACKBOX_*.json`` (`python -m gigapaxos_tpu.blackbox replay ...
+    --json-out ...`): per-capture verdict, wave/group coverage, and the
+    capture's byte overhead rate.  A DIVERGED verdict here means the
+    engine stopped being a deterministic function of its captured
+    input — the same drift-visibility the perf rows give throughput."""
+    files = sorted(glob.glob(os.path.join(HERE, "BLACKBOX_*.json")))
+    if not files:
+        return []
+    name = os.path.basename(files[-1])
+    art = _load(name)
+    if not art or not art.get("captures"):
+        return []
+    out = []
+    for rep in art["captures"]:
+        if rep.get("verdict") == "ERROR":
+            out.append(
+                f"| Flight-recorder replay `{os.path.basename(str(rep.get('file')))}` "
+                f"(`{name}`) | **ERROR: {rep.get('error')}** |")
+            continue
+        verdict = ("**bit-for-bit MATCH**"
+                   if rep.get("verdict") == "MATCH"
+                   else f"**{rep.get('verdict')}** "
+                   f"({rep.get('waves_diverged')} wave(s), "
+                   f"{len(rep.get('group_mismatches', []))} group(s))")
+        rate = rep.get("capture_overhead_bytes_per_s")
+        out.append(
+            f"| Flight-recorder replay "
+            f"`{os.path.basename(str(rep.get('file')))}` "
+            f"(node {rep.get('node')}, `{name}`) | {verdict}; "
+            f"{rep.get('waves_captured')} waves, "
+            f"{rep.get('groups')} groups verified; "
+            f"{rep.get('frames')} frames / {rep.get('bytes')} B captured"
+            + (f" ({rate} B/s ring overhead)" if rate else "")
+            + " |")
     return out
 
 

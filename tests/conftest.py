@@ -52,6 +52,7 @@ def _clean_config():
 
 @pytest.fixture(autouse=True)
 def _clean_profiler():
+    from gigapaxos_tpu.blackbox.recorder import BlackboxRecorder
     from gigapaxos_tpu.chaos.faults import ChaosPlane
     from gigapaxos_tpu.utils.instrument import RequestInstrumenter
     from gigapaxos_tpu.utils.profiler import DelayProfiler
@@ -63,3 +64,6 @@ def _clean_profiler():
     # and the chaos fault plane (rules, partitions, seed): a failing
     # chaos test must not leave injected faults to poison later tests
     ChaosPlane.reset()
+    # and the flight-recorder registry (PC.BLACKBOX_*): recorders of
+    # nodes a test leaked must not receive later dump_all() triggers
+    BlackboxRecorder.reset()

@@ -1,0 +1,443 @@
+"""Flight recorder (PR 8): capture ring bounding, trigger-dump plumbing,
+.gpbb structural checks, the HTTP surface, and the acceptance path —
+capture -> deterministic offline replay -> digest parity, on live mini
+clusters under chaos and on the committed reference capture (format
+drift guard, ``smoke``)."""
+
+import json
+import os
+import struct
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from gigapaxos_tpu.blackbox import capture as cap_mod
+from gigapaxos_tpu.blackbox.capture import (CaptureError, read_capture,
+                                            write_capture)
+from gigapaxos_tpu.blackbox.recorder import BlackboxRecorder
+from gigapaxos_tpu.blackbox.replay import replay_capture
+from gigapaxos_tpu.chaos.faults import ChaosPlane
+from gigapaxos_tpu.paxos.interfaces import CounterApp, NoopApp
+from gigapaxos_tpu.paxos.paxosconfig import PC
+from gigapaxos_tpu.utils.config import Config
+
+from tests.conftest import tscale
+
+REFERENCE = os.path.join(os.path.dirname(__file__), "data",
+                         "reference.gpbb")
+
+
+def _wait(pred, deadline_s=5.0, interval_s=0.02):
+    end = time.time() + tscale(deadline_s)
+    while time.time() < end:
+        if pred():
+            return True
+        time.sleep(interval_s)
+    return pred()
+
+
+# --------------------------------------------------------------------------
+# ring bounding + eviction
+# --------------------------------------------------------------------------
+
+
+def test_ring_bounded_by_bytes(tmp_path):
+    """The byte budget holds: oldest records evict first and the
+    accounted total never exceeds the budget."""
+    rec = BlackboxRecorder(0, str(tmp_path), max_bytes=4096)
+    first = [b"a" * 200, b"b" * 200]
+    rec.note_frames(time.time(), 1, 0, first)
+    for w in range(2, 101):
+        rec.note_frames(time.time(), w, 0,
+                        [bytes([w % 256]) * 200] * 2)
+    snap = rec.snapshot()
+    assert snap["bytes"] <= 4096
+    assert snap["evicted"] > 0
+    assert snap["total_records"] == 100
+    assert snap["records"] == snap["total_records"] - snap["evicted"]
+    # newest survives, oldest is gone
+    out = rec.export()
+    assert out[-1]["wave"] == 100
+    assert all(r["wave"] != 1 for r in out)
+    rec.close()
+
+
+def test_ring_bounded_by_age(tmp_path):
+    """PC.BLACKBOX_S semantics: records older than the horizon are
+    evicted on the next append."""
+    rec = BlackboxRecorder(0, str(tmp_path), max_bytes=1 << 20,
+                           max_age_s=0.05)
+    rec.note_ingress(1, 10)
+    time.sleep(0.12)
+    rec.note_ingress(2, 20)
+    snap = rec.snapshot()
+    assert snap["records"] == 1 and snap["evicted"] == 1
+    assert rec.export()[0]["frames"] == 2
+    rec.close()
+
+
+# --------------------------------------------------------------------------
+# trigger-dump plumbing
+# --------------------------------------------------------------------------
+
+
+def test_trigger_dumps_async_and_cooldown(tmp_path):
+    """trigger() dumps on a background thread (callers may hold engine
+    locks), honors the cooldown, and the dump file parses back."""
+    rec = BlackboxRecorder(3, str(tmp_path), max_bytes=1 << 20,
+                           dump_on_slow=True, cooldown_s=60.0)
+    rec.note_frames(time.time(), 7, 0, [b"\x01\x02\x03"])
+    assert rec.trigger("slow_trace") is True
+    assert _wait(lambda: rec.snapshot()["last_dump"] is not None)
+    assert rec.trigger("slow_trace") is False  # cooldown
+    path = rec.snapshot()["last_dump"]
+    recs, man = read_capture(path)
+    assert man["reason"] == "slow_trace" and man["node"] == 3
+    assert recs[0]["t"] == "F" and recs[0]["frames"] == [b"\x01\x02\x03"]
+    rec.close()
+
+
+def test_trigger_noop_when_disarmed(tmp_path):
+    """auto_trigger=False (the replay-side recorder) never dumps."""
+    rec = BlackboxRecorder(0, str(tmp_path), max_bytes=1 << 20)
+    rec.auto_trigger = False
+    assert rec.trigger("slow_trace") is False
+    assert rec.snapshot()["dumps"] == 0
+    rec.close()
+
+
+def test_churn_spike_trips_a_dump(tmp_path):
+    """A ballot-change burst beyond churn_spike within the window fires
+    the churn trigger (the leader-churn pathology signature)."""
+    rec = BlackboxRecorder(1, str(tmp_path), max_bytes=1 << 20,
+                           cooldown_s=0.0)
+    rec.note_ingress(1, 1)
+    rec.note_churn(0)      # window mark
+    rec.note_churn(10)     # below spike: no dump
+    assert rec.snapshot()["dumps"] == 0
+    rec.note_churn(10 + rec.churn_spike)
+    assert _wait(lambda: rec.snapshot()["last_dump"] is not None)
+    _recs, man = read_capture(rec.snapshot()["last_dump"])
+    assert man["reason"] == "churn_spike"
+    rec.close()
+
+
+def test_dump_all_covers_live_recorders(tmp_path):
+    """dump_all (SIGTERM / fatal exception / invariant violation) hits
+    every registered recorder, in node order; closed ones drop out."""
+    a = BlackboxRecorder(1, str(tmp_path), max_bytes=1 << 20)
+    b = BlackboxRecorder(0, str(tmp_path), max_bytes=1 << 20)
+    a.note_ingress(1, 1)
+    b.note_ingress(1, 1)
+    paths = BlackboxRecorder.dump_all("test")
+    assert len(paths) == 2
+    assert [read_capture(p)[1]["node"] for p in paths] == [0, 1]
+    b.close()
+    assert len(BlackboxRecorder.dump_all("test")) == 1
+    a.close()
+    assert BlackboxRecorder.dump_all("test") == []
+
+
+# --------------------------------------------------------------------------
+# disabled path: the default must cost one attribute check, no recorder
+# --------------------------------------------------------------------------
+
+
+def test_disabled_by_default_no_recorder(tmp_path):
+    """PC.BLACKBOX_MB=0 (default): no recorder anywhere — every hook
+    site's `blackbox is not None` gate stays False and the live
+    registry stays empty."""
+    from gigapaxos_tpu.paxos.manager import PaxosNode
+    from gigapaxos_tpu.testing.harness import free_ports
+
+    node = PaxosNode(0, {0: ("127.0.0.1", free_ports(1)[0])}, NoopApp(),
+                     str(tmp_path), backend="columnar", capacity=64,
+                     window=4)
+    try:
+        assert node.blackbox is None
+        assert node.transport.blackbox is None
+        assert node.logger.blackbox is None
+        with BlackboxRecorder._live_lock:
+            assert not BlackboxRecorder._live
+        assert BlackboxRecorder.dump_all("test") == []
+    finally:
+        node.stop()
+
+
+# --------------------------------------------------------------------------
+# .gpbb structural checks
+# --------------------------------------------------------------------------
+
+
+def _sample_records():
+    return [
+        {"t": "I", "ts": 1.0, "frames": 2, "bytes": 64},
+        {"t": "F", "ts": 1.1, "wave": 5, "lane": 0,
+         "frames": [b"\x00\x01", b"", b"abc"]},
+        {"t": "W", "ts": 1.2, "wave": 5, "lane": 0, "items": 3,
+         "pre": 123, "post": 456, "chaos": [1, 0, 2, 0]},
+        {"t": "L", "ts": 1.3, "wave": 5, "seg": 0, "off": 4096, "n": 3},
+        {"t": "T", "ts": 1.4, "wave": 5, "lane": 0},
+    ]
+
+
+def test_capture_roundtrip(tmp_path):
+    path = str(tmp_path / "x.gpbb")
+    man = {"format": "gpbb1", "node": 2, "reason": "test",
+           "n_evicted": 0}
+    write_capture(path, _sample_records(), man)
+    recs, got = read_capture(path)
+    assert got == man
+    assert recs == _sample_records()
+    assert not os.path.exists(path + ".tmp")  # atomic write cleaned up
+
+
+def test_capture_bad_magic(tmp_path):
+    path = str(tmp_path / "bad.gpbb")
+    with open(path, "wb") as f:
+        f.write(b"NOTGP\0plus some trailing garbage")
+    with pytest.raises(CaptureError, match="bad magic"):
+        read_capture(path)
+
+
+def test_capture_torn_tail(tmp_path):
+    """A capture truncated mid-record (the crash-mid-dump shape the
+    atomic writer prevents, but a copied/partial file can still show)
+    fails with a message naming the byte offset."""
+    path = str(tmp_path / "t.gpbb")
+    write_capture(path, _sample_records(), {"node": 0, "n_evicted": 0})
+    data = open(path, "rb").read()
+    torn = str(tmp_path / "torn.gpbb")
+    with open(torn, "wb") as f:
+        f.write(data[:-10])
+    with pytest.raises(CaptureError, match="torn"):
+        read_capture(torn)
+
+
+def test_capture_missing_manifest(tmp_path):
+    """Records but no trailing manifest: structurally valid prefix,
+    still rejected — replay has no ground truth to verify against."""
+    body = json.dumps({"t": "I", "ts": 0.0, "frames": 1,
+                       "bytes": 2}).encode()
+    path = str(tmp_path / "nm.gpbb")
+    with open(path, "wb") as f:
+        f.write(cap_mod.MAGIC)
+        f.write(struct.pack("<IB", len(body), ord("I")) + body)
+    with pytest.raises(CaptureError, match="no manifest"):
+        read_capture(path)
+
+
+def test_capture_record_after_manifest(tmp_path):
+    path = str(tmp_path / "am.gpbb")
+    write_capture(path, [], {"node": 0, "n_evicted": 0})
+    body = json.dumps({"t": "I", "ts": 0.0, "frames": 1,
+                       "bytes": 2}).encode()
+    with open(path, "ab") as f:
+        f.write(struct.pack("<IB", len(body), ord("I")) + body)
+    with pytest.raises(CaptureError, match="manifest must be last"):
+        read_capture(path)
+
+
+# --------------------------------------------------------------------------
+# HTTP surface
+# --------------------------------------------------------------------------
+
+
+def test_blackbox_http_routes(tmp_path):
+    """GET /blackbox (snapshot) and /blackbox/dump on the per-node
+    stats listener; disabled nodes answer enabled:false and 409."""
+    from gigapaxos_tpu.paxos.manager import PaxosNode
+    from gigapaxos_tpu.testing.harness import free_ports
+
+    Config.set(PC.STATS_PORT, 0)
+    Config.set(PC.BLACKBOX_MB, 4)
+    Config.set(PC.BLACKBOX_S, 0.0)
+    node = PaxosNode(0, {0: ("127.0.0.1", free_ports(1)[0])}, NoopApp(),
+                     str(tmp_path / "on"), backend="columnar",
+                     capacity=64, window=4)
+    node.start()
+    try:
+        port = node.stats_http.port
+
+        def get(path):
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}{path}",
+                    timeout=tscale(5)) as r:
+                return r.status, json.loads(r.read())
+
+        st, d = get("/blackbox")
+        assert st == 200 and d["enabled"] is True
+        assert d["budget_bytes"] == 4 << 20
+        st, d = get("/blackbox/dump")
+        assert st == 200 and d["dumped"].endswith(".gpbb")
+        _recs, man = read_capture(d["dumped"])
+        assert man["reason"] == "http"
+        assert "groups" in man  # node manifest rode along
+    finally:
+        node.stop()
+
+    Config.set(PC.BLACKBOX_MB, 0)
+    node = PaxosNode(0, {0: ("127.0.0.1", free_ports(1)[0])}, NoopApp(),
+                     str(tmp_path / "off"), backend="columnar",
+                     capacity=64, window=4)
+    node.start()
+    try:
+        port = node.stats_http.port
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/blackbox",
+                timeout=tscale(5)) as r:
+            assert json.loads(r.read()) == {"enabled": False}
+        try:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/blackbox/dump",
+                timeout=tscale(5))
+            assert False, "expected 409"
+        except urllib.error.HTTPError as e:
+            assert e.code == 409
+    finally:
+        node.stop()
+
+
+# --------------------------------------------------------------------------
+# acceptance: capture -> offline replay -> digest parity
+# --------------------------------------------------------------------------
+
+
+def _quiesce(emu, deadline_s=12.0):
+    """Wait until executed-counters are stable across two consecutive
+    polls (delayed chaos frames drained, no state-changing traffic)."""
+    last, stable = None, 0
+    end = time.time() + tscale(deadline_s)
+    while time.time() < end:
+        cur = tuple(nd.n_executed for _i, nd in sorted(emu.nodes.items())
+                    if nd is not None)
+        if cur == last:
+            stable += 1
+            if stable >= 2:
+                return
+        else:
+            stable = 0
+        last = cur
+        time.sleep(tscale(0.3))
+
+
+@pytest.mark.parametrize("backend,shards", [
+    ("columnar", 1), ("columnar", 4), ("native", 1)])
+def test_capture_replay_parity_mini_chaos_drill(tmp_path, backend,
+                                                shards):
+    """The tentpole end to end: a 3-node cluster under chaos delay +
+    reorder serves client load with the ring armed; each node's dump
+    then replays offline to a bit-for-bit digest MATCH — per-wave
+    pre/post lane digests AND final per-group app digests/cursors."""
+    from gigapaxos_tpu.testing.harness import PaxosEmulation
+
+    Config.set(PC.BLACKBOX_MB, 8)
+    Config.set(PC.BLACKBOX_S, 0.0)
+    if shards > 1:
+        Config.set(PC.ENGINE_SHARDS, shards)
+    ChaosPlane.reset()
+    ChaosPlane.configure(seed=11, enabled=True)
+    ChaosPlane.set_link(None, None, delay_s=0.001, jitter_s=0.002,
+                        reorder_p=0.2)
+    emu = PaxosEmulation(str(tmp_path), n_nodes=3, n_groups=6,
+                         backend=backend, app_cls=CounterApp,
+                         capacity=1 << 10, window=16)
+    try:
+        res = emu.run_load(60, concurrency=12, timeout=tscale(20))
+        assert res["ok"] > 0, res
+        ChaosPlane.clear()
+        _quiesce(emu)
+        for i, nd in sorted(emu.nodes.items()):
+            assert nd.blackbox is not None
+            path = nd.blackbox.dump("parity_test")
+            recs, man = read_capture(path)
+            assert man["n_evicted"] == 0
+            # chaos fault counters rode the wave summaries
+            assert any(r["t"] == "W" and r["chaos"] is not None
+                       for r in recs)
+            rep = replay_capture(path)
+            assert rep["verdict"] == "MATCH", (backend, shards, i, rep)
+            assert not rep["partial"]
+            assert rep["waves_replayed"] > 0
+            assert rep["groups"] == 6
+            assert not rep["group_mismatches"]
+    finally:
+        emu.stop()
+        ChaosPlane.reset()
+
+
+def test_invariant_violation_auto_dumps_and_replays(tmp_path,
+                                                    monkeypatch):
+    """Acceptance: a chaos scenario with a forced invariant violation
+    (forced at the checker — correct nodes can't produce an organic
+    one) auto-dumps every node's ring, attaches the paths to the
+    artifact row, and offline replay reproduces the captured per-group
+    digests bit-for-bit."""
+    from gigapaxos_tpu.chaos import invariants as inv
+    from gigapaxos_tpu.chaos.scenarios import run_scenario
+
+    Config.set(PC.BLACKBOX_MB, 8)
+    Config.set(PC.BLACKBOX_S, 0.0)
+    monkeypatch.setattr(
+        inv, "digests_converged",
+        lambda digests: ["forced: digest divergence (drill)"])
+    row = run_scenario("mini_partition_heal", seed=1,
+                       workdir=str(tmp_path))
+    assert not row["ok"]
+    assert "forced: digest divergence (drill)" in row["violations"]
+    assert row.get("blackbox"), row
+    for p in row["blackbox"]:
+        recs, man = read_capture(p)
+        assert man["reason"] == "invariant_violation"
+        rep = replay_capture(p)
+        assert rep["verdict"] == "MATCH", (p, rep)
+        assert rep["groups"] > 0
+        assert not rep["group_mismatches"]
+
+
+def test_record_demo_roundtrip_sharded(tmp_path):
+    """The offline capture generator (reference.gpbb's producer) stays
+    replayable on the sharded engine path too."""
+    from gigapaxos_tpu.blackbox.__main__ import record_demo
+
+    out = str(tmp_path / "cap.gpbb")
+    record_demo(out, n_requests=36, n_groups=8, shards=4)
+    rep = replay_capture(out)
+    assert rep["verdict"] == "MATCH", rep
+    assert rep["waves_replayed"] > 0 and rep["groups"] == 8
+
+
+# --------------------------------------------------------------------------
+# format drift guard: the committed reference capture must keep replaying
+# --------------------------------------------------------------------------
+
+
+@pytest.mark.smoke
+def test_reference_capture_replays_match(tmp_path):
+    """bin/check's guard, test form: the committed capture from an
+    older writer must parse and replay MATCH forever — regenerate it
+    (python -m gigapaxos_tpu.blackbox record-demo) only on a versioned
+    format change."""
+    rep = replay_capture(REFERENCE)
+    assert rep["verdict"] == "MATCH", rep
+    assert rep["waves_replayed"] > 0 and rep["groups"] == 4
+    assert rep["frames"] > 0
+
+
+@pytest.mark.smoke
+def test_replay_cli_exit_codes_and_artifact(tmp_path):
+    """CLI contract: exit 0 on MATCH with the --json-out artifact
+    render_perf.py consumes; exit 2 on a broken capture."""
+    from gigapaxos_tpu.blackbox.__main__ import main
+
+    art = str(tmp_path / "BLACKBOX_r99.json")
+    assert main(["replay", REFERENCE, "--json-out", art]) == 0
+    with open(art) as f:
+        doc = json.load(f)
+    assert doc["captures"][0]["verdict"] == "MATCH"
+    bad = str(tmp_path / "bad.gpbb")
+    with open(bad, "wb") as f:
+        f.write(b"NOTGP\0nope")
+    assert main(["replay", bad]) == 2
