@@ -271,6 +271,100 @@ def _baseline_pipeline(make_backend, G, W, B, iters):
     return decided / dt
 
 
+def _wire_rollup(emu) -> dict:
+    """Cluster-wide wire-efficiency rollup: total wire bytes and
+    writer/reader calls (the syscall proxy) summed over every live
+    node, amortized per decided slot.  The two ratios the wire-
+    aggregation plane moves; run_full/bench_wire_ab put them in the
+    artifact of record."""
+    tx_b = rx_b = wr = rd = frags = members = dec = 0
+    for nd in emu.nodes.values():
+        if nd is None:
+            continue
+        m = nd.metrics(include_profiler=False)
+        net = m["net"]
+        tx_b += net["tx_bytes"]
+        rx_b += net["rx_bytes"]
+        wr += net["tx_writes"]
+        rd += net["rx_reads"]
+        frags += net["tx_frags"]
+        members += net["tx_frag_members"]
+        dec += m["counters"]["decided"]
+    return {
+        "tx_bytes": tx_b, "rx_bytes": rx_b,
+        "tx_writes": wr, "rx_reads": rd,
+        "tx_frags": frags, "tx_frag_members": members,
+        "decided": dec,
+        "bytes_per_decision":
+            round((tx_b + rx_b) / dec, 2) if dec else 0.0,
+        "syscalls_per_decision":
+            round((wr + rd) / dec, 4) if dec else 0.0,
+    }
+
+
+def bench_wire_ab(n_requests: int = 4000, groups: int = 1,
+                  depth: int = 64, window: int = 64,
+                  entry_shift: int = 1) -> dict:
+    """Wire-aggregation A/B: the SAME storm-concurrency loopback
+    workload with per-peer coalescing + SoA receive OFF (byte-for-byte
+    the pre-aggregation wire) and ON, reporting cluster-wide
+    bytes/decision and syscalls/decision for each arm.  Fresh 3-node
+    emulations per arm so every counter starts from zero.
+
+    The default shape is the wire plane's home turf — the storm
+    profile the tentpole targets: few hot groups with a deep slot
+    window (per-group accept/reply/commit columns are constant-or-
+    arithmetic, so the SoA packers collapse them) and entry_shift=1
+    (requests land on a non-coordinator, so every request crosses the
+    peer wire as a Proposal frame the coalescer can aggregate)."""
+    import shutil
+    import tempfile
+
+    from gigapaxos_tpu.testing.harness import PaxosEmulation
+    from gigapaxos_tpu.utils.config import Config
+    from gigapaxos_tpu.paxos.paxosconfig import PC
+
+    prev = (Config.get(PC.WIRE_COALESCE), Config.get(PC.WIRE_SOA_RX))
+    arms = {}
+    try:
+        for label, on in (("off", False), ("on", True)):
+            Config.set(PC.WIRE_COALESCE, on)
+            Config.set(PC.WIRE_SOA_RX, on)
+            logdir = tempfile.mkdtemp(prefix=f"gp_bench_wire_{label}_")
+            emu = PaxosEmulation(logdir, n_nodes=3, n_groups=groups,
+                                 backend="native", window=window)
+            try:
+                res = emu.run_load_fast(n_requests, concurrency=depth,
+                                        entry_shift=entry_shift)
+                arms[label] = {
+                    "throughput_rps": res["throughput_rps"],
+                    "ok": res["ok"], "errors": res["errors"],
+                    "wire": _wire_rollup(emu),
+                }
+            finally:
+                emu.stop()
+                shutil.rmtree(logdir, ignore_errors=True)
+    finally:
+        Config.set(PC.WIRE_COALESCE, prev[0])
+        Config.set(PC.WIRE_SOA_RX, prev[1])
+
+    def ratio(key):
+        a = arms["off"]["wire"][key]
+        b = arms["on"]["wire"][key]
+        return round(a / b, 2) if b else None
+
+    return {
+        "metric": "wire bytes+syscalls per decision, coalescing "
+                  "off vs on (3 replicas, loopback, storm depth "
+                  f"{depth}, W={window}, entry_shift={entry_shift})",
+        "n_requests": n_requests, "groups": groups, "depth": depth,
+        "window": window, "entry_shift": entry_shift,
+        "off": arms["off"], "on": arms["on"],
+        "bytes_per_decision_ratio": ratio("bytes_per_decision"),
+        "syscalls_per_decision_ratio": ratio("syscalls_per_decision"),
+    }
+
+
 def bench_e2e_runtime(n_requests: int = 6000, groups: int = 1000,
                       depth: int = 448, backend: str = "native",
                       engine_shards: int = 1) -> dict:
@@ -308,6 +402,10 @@ def bench_e2e_runtime(n_requests: int = 6000, groups: int = 1000,
                               "throughput_rps": lat["throughput_rps"],
                               "lat_p50_ms": lat["lat_p50_ms"],
                               "lat_p99_ms": lat["lat_p99_ms"]},
+            # wire-efficiency rollup (bytes + syscalls per decision)
+            # over the whole run, so every e2e row carries the numbers
+            # the wire-aggregation plane moves
+            "wire": _wire_rollup(emu),
             # stage budgets + histogram tails (p50/p99 per update_delay
             # tag) embedded in the artifact of record
             "profiler": DelayProfiler.snapshot(buckets=False),
@@ -413,6 +511,9 @@ def _parser():
     p.add_argument("--full", action="store_true",
                    help="run the WHOLE BASELINE.md benchmark matrix "
                         "(configs 1-5) and write BENCH_FULL.json")
+    p.add_argument("--wire-ab", action="store_true",
+                   help="A/B the wire-aggregation plane (coalescing "
+                        "off vs on) and write BENCH_WIRE.json")
     return p
 
 
@@ -577,6 +678,19 @@ def main():
     args = _parser().parse_args()
     if args.full:
         return run_full(args)
+    if args.wire_ab:
+        with bench_lock():
+            out = bench_wire_ab(1200 if args.quick else 4000)
+        out["recorded_at"] = time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                           time.gmtime())
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "BENCH_WIRE.json")
+        tmp = path + f".tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(out, f, indent=1)
+        os.replace(tmp, path)
+        print(json.dumps(out))
+        return 0
     if args.quick:
         args.groups, args.batch, args.iters = 1 << 14, 1 << 12, 5
         args.baseline_groups, args.baseline_batch = 1 << 12, 1 << 11

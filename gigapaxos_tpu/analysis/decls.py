@@ -166,6 +166,15 @@ def project_decls() -> Decls:
                                  "ChaosPlane.enabled")),
         "Transport._enqueue_now": HotPath("lean"),
         "Transport._write": HotPath("lean"),
+        # wire-plane aggregation (PR 13): the emit coalescer and the
+        # FRAG codec sit on every storm-path frame; allocation is
+        # their job, logging never is
+        "Transport.send_many": HotPath("lean"),
+        "Transport.send_frags": HotPath("lean"),
+        "Transport._make_chunk": HotPath("lean"),
+        "WireChunk.__init__": HotPath("lean"),
+        "Frag.encode": HotPath("lean"),
+        "Frag.split": HotPath("lean"),
         "ChaosPlane.on_send": HotPath("lean"),
         # per-request tracing hooks: one attribute check when off
         "RequestInstrumenter.record": HotPath(
@@ -234,5 +243,8 @@ def project_decls() -> Decls:
             # read at node boot into per-node state, torn down with
             # the node; Config.clear() coverage is enough
             "STATS_": None,
+            # wire-plane knobs (PR 13): read once into the Transport at
+            # node boot, torn down with the node — same contract
+            "WIRE_": None,
         },
     )

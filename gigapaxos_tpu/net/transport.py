@@ -33,8 +33,11 @@ import time
 from collections import deque
 from typing import Awaitable, Callable, Dict, Optional, Tuple
 
+import numpy as np
+
 from gigapaxos_tpu import native
 from gigapaxos_tpu.chaos.faults import ChaosPlane
+from gigapaxos_tpu.paxos import packets as pk
 from gigapaxos_tpu.utils.logutil import get_logger
 from gigapaxos_tpu.utils.profiler import DelayProfiler
 
@@ -42,6 +45,36 @@ log = get_logger("gp.net")
 
 _LEN = struct.Struct("<I")
 MAX_FRAME = native.MAX_FRAME  # one limit for scan + send paths
+_FRAG_T = int(pk.PacketType.FRAG)
+_HELLO_T = int(pk.PacketType.WIRE_HELLO)
+# n_items field of the frame header (u32 at offset 5, after type+sender)
+_HDR_N = struct.Struct("<I")
+
+
+class WireChunk:
+    """One scan-chunk of received frames as SoA columns (zero-copy
+    receive): the consumed region as ONE immutable blob plus int64
+    offset/length arrays straight from the native scan, with ``types``
+    a single vectorized gather of every frame's type byte.  Consumers
+    (the decode-split stage) read columns out of the blob via
+    ``np.frombuffer`` views instead of slicing per-frame ``bytes`` —
+    a 10K-frame storm chunk is one numpy pass, not 10K allocations."""
+
+    __slots__ = ("blob", "offs", "lens", "types")
+
+    def __init__(self, blob: bytes, offs: np.ndarray,
+                 lens: np.ndarray):
+        self.blob = blob
+        self.offs = offs
+        self.lens = lens
+        self.types = np.frombuffer(blob, np.uint8)[offs]
+
+    def __len__(self) -> int:
+        return len(self.offs)
+
+    def view(self, i: int) -> memoryview:
+        o = int(self.offs[i])
+        return memoryview(self.blob)[o:o + int(self.lens[i])]
 
 
 class Demultiplexer:
@@ -90,7 +123,9 @@ class Transport:
                  ssl_server: Optional[ssl_mod.SSLContext] = None,
                  ssl_client: Optional[ssl_mod.SSLContext] = None,
                  reconnect_base_s: float = 0.05,
-                 on_frames: Optional[Callable[[list], None]] = None):
+                 on_frames: Optional[Callable[[list], None]] = None,
+                 wire_coalesce: bool = False, coalesce_min: int = 2,
+                 rx_chunks: bool = False):
         self.id = node_id
         self.listen_addr = listen_addr
         self.addr_map = dict(addr_map)
@@ -151,6 +186,27 @@ class Transport:
         # insert from blowing up a concurrent scrape's iteration.
         self._rtt: Dict[int, list] = {}
         self._rtt_lock = threading.Lock()
+
+        # wire-plane aggregation: coalesce same-peer frames
+        # into FRAG super-frames — but only toward peers that announced
+        # a compatible wire version (peer_wire, learned from their
+        # WIRE_HELLO; empty until then, so old nodes keep getting the
+        # plain per-frame path).  rx_chunks switches the scan loop from
+        # per-frame bytes slices to SoA WireChunk delivery.  All state
+        # below is event-loop-owned (single-writer, like the counters).
+        self.wire_coalesce = bool(wire_coalesce)
+        self.coalesce_min = max(2, int(coalesce_min))
+        self.rx_chunks = bool(rx_chunks)
+        self.peer_wire: Dict[int, int] = {}
+        # syscall-proxy + container counters for the wire-efficiency
+        # metrics (net.syscalls_per_decision): one tx_write per writer
+        # call, one rx_read per non-empty socket read
+        self.tx_writes = 0
+        self.rx_reads = 0
+        self.tx_frags = 0
+        self.tx_frag_members = 0
+        self.rx_frags = 0
+        self.rx_frag_members = 0
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -308,15 +364,121 @@ class Transport:
         """Enqueue ``[(dst, payload, preframed, nframes), ...]`` — ONE
         loop hop for a whole worker batch's sends (each
         ``call_soon_threadsafe`` writes the loop's wake pipe; a worker
-        batch fans out to several destinations)."""
+        batch fans out to several destinations).  With wire coalescing
+        on, a destination's plain frames collapse into one FRAG
+        super-frame via :meth:`send_frags` — per-destination send order
+        is preserved (a non-coalescible item flushes the pending
+        group first), and only peers that announced a compatible wire
+        version participate."""
+        if not self.wire_coalesce:
+            for dst, payload, preframed, nframes in items:
+                self._enqueue(dst, payload, preframed, nframes)
+            return
+        groups: Dict[int, list] = {}
         for dst, payload, preframed, nframes in items:
+            if not preframed and self.peer_wire.get(dst, 0) >= 1 \
+                    and dst in self.addr_map:
+                g = groups.get(dst)
+                if g is None:
+                    g = groups[dst] = []
+                g.append(payload)
+                continue
+            pend = groups.pop(dst, None)
+            if pend is not None:
+                self._flush_group(dst, pend)
             self._enqueue(dst, payload, preframed, nframes)
+        for dst, bufs in groups.items():
+            self._flush_group(dst, bufs)
+
+    def _flush_group(self, dst: int, bufs: list) -> None:
+        # a lone column-packable batch frame still rides a 1-member
+        # FRAG when that shrinks it (send_frags falls back otherwise)
+        if len(bufs) >= self.coalesce_min or \
+                (len(bufs) == 1 and pk.packable(bufs[0])):
+            self.send_frags(dst, bufs)
+        else:
+            for b in bufs:
+                self._enqueue(dst, b, False, 1)
+
+    def send_frags(self, dst: int, bufs: list) -> bool:
+        """Scatter-gather send: coalesce ``bufs`` (canonical frames all
+        bound for peer ``dst``) into ONE super-frame handed to the
+        socket as a ``writev``-style buffer list.  The test/chaos fault
+        gates are served per MEMBER in send order first — the verdict
+        stream is identical to N :meth:`send` calls, so chaos schedule
+        fingerprints are stable and drop/delay verdicts split the
+        container (the affected member travels alone or not at all)."""
+        keep = bufs
+        if self.test_drop_rate > 0.0:
+            if self._drop_rng is None:
+                import random
+                self._drop_rng = random.Random(self.id * 7919 + 13)
+            keep = []
+            for b in bufs:
+                if self._drop_rng.random() < self.test_drop_rate:
+                    self._drop(1, "test")
+                else:
+                    keep.append(b)
+        if ChaosPlane.enabled and dst in self.addr_map:
+            kept = []
+            for b in keep:
+                drop, delay = ChaosPlane.on_send(self.id, dst, 1)
+                if drop:
+                    self._drop(1, "chaos")
+                elif delay > 0.0:
+                    self._loop.call_later(delay, self._chaos_release,
+                                          dst, b, False, 1)
+                else:
+                    kept.append(b)
+            keep = kept
+        if not keep:
+            return True
+        if len(keep) == 1 and not pk.packable(keep[0]):
+            return self._enqueue_now(dst, keep[0], False, 1)
+        parts, total = pk.Frag.encode(self.id, keep)
+        if total > MAX_FRAME or \
+                (len(keep) == 1 and total >= len(keep[0])):
+            ok = True
+            for b in keep:
+                ok = self._enqueue_now(dst, b, False, 1) and ok
+            return ok
+        nf = len(keep)
+        peer = self._peers.get(dst)
+        if peer is None:
+            peer = self._peers[dst] = _Peer()
+            peer.task = self._loop.create_task(self._writer_loop(dst))
+        parts[0] = _LEN.pack(total) + parts[0]
+        if peer.writer is not None and not peer.queue and \
+                self.direct_write and not peer.writer.is_closing():
+            w = peer.writer
+            if w.transport.get_write_buffer_size() + total + 4 > \
+                    self.max_queue_bytes:
+                self._drop(nf, "congestion")
+                return False
+            w.writelines(parts)
+            self.sent_frames += nf
+            self.sent_bytes += total + 4
+            self.tx_writes += 1
+            self.tx_frags += 1
+            self.tx_frag_members += nf
+            return True
+        payload = b"".join(parts)
+        if peer.bytes_queued + len(payload) > self.max_queue_bytes:
+            self._drop(nf, "congestion")
+            return False
+        peer.queue.append((payload, True, nf))
+        peer.bytes_queued += len(payload)
+        peer.wake.set()
+        self.tx_frags += 1
+        self.tx_frag_members += nf
+        return True
 
     def send_many_threadsafe(self, items: list) -> None:
         self._loop.call_soon_threadsafe(self.send_many, items)
 
     def _write(self, w: asyncio.StreamWriter, payload: bytes,
                preframed: bool, nframes: int) -> None:
+        self.tx_writes += 1
         if preframed:
             w.write(payload)
             self.sent_frames += nframes
@@ -353,6 +515,13 @@ class Transport:
             # handshake: identify ourselves so the far side can map the
             # connection to our node id (replies to unmapped ids)
             writer.write(_LEN.pack(4) + struct.pack("<i", self.id))
+            if self.wire_coalesce:
+                # announce our wire version before any payload frame so
+                # the far side can start coalescing toward us; sent per
+                # connection (the receiver's first-frame intercept is
+                # per scan loop), deliberately outside the chaos gates
+                # — it is link control, not protocol traffic
+                self._write(writer, pk.wire_hello(self.id), False, 1)
             # connections are bidirectional: the far side may send replies
             # back over this link (client reply path), so read it too.
             # The read side reaching EOF is ALSO our only prompt signal
@@ -397,32 +566,105 @@ class Transport:
         awaits per frame.  Raises ValueError on an oversized frame
         (protocol violation -> drop the connection)."""
         buf = bytearray()
+        first = True
         while True:
             chunk = await reader.read(1 << 18)
             if not chunk:
                 return
+            self.rx_reads += 1
             buf += chunk
             offs, lens, consumed = native.scan_frames(buf)
             if len(offs):
                 mv = memoryview(buf)
-                frames = [bytes(mv[int(o):int(o) + int(ln)])
-                          for o, ln in zip(offs, lens)]
-                del mv
-                self.rcvd_frames += len(frames)
-                self.rcvd_bytes += consumed
-                bb = self.blackbox
-                if bb is not None:
-                    bb.note_ingress(len(frames), consumed)
-                if self.on_frames is not None:
-                    try:
-                        self.on_frames(frames)
-                    except Exception:
-                        log.exception("batch handler failed")
+                start = 0
+                if first:
+                    # a coalescing peer's first frame is its version
+                    # hello: record and swallow (never delivered)
+                    first = False
+                    o0, l0 = int(offs[0]), int(lens[0])
+                    if l0 >= 10 and buf[o0] == _HELLO_T:
+                        try:
+                            s, v = pk.parse_wire_hello(
+                                bytes(mv[o0:o0 + l0]))
+                        except ValueError:
+                            pass
+                        else:
+                            self.peer_wire[s] = v
+                            self.rcvd_frames += 1
+                            start = 1
+                if self.rx_chunks:
+                    ck = self._make_chunk(mv, offs, lens, start,
+                                          consumed)
+                    if ck is not None:
+                        if self.on_frames is not None:
+                            try:
+                                self.on_frames([ck])
+                            except Exception:
+                                log.exception("batch handler failed")
+                        else:
+                            for i in range(len(ck)):
+                                self._dispatch(bytes(ck.view(i)))
                 else:
-                    for f in frames:
-                        self._dispatch(f)
+                    frames = [bytes(mv[int(o):int(o) + int(ln)])
+                              for o, ln in zip(offs[start:],
+                                               lens[start:])]
+                    n_log = len(frames)
+                    if self.wire_coalesce:
+                        # count FRAG containers as their member frames
+                        # (rx_frames stays the logical-frame counter)
+                        for f in frames:
+                            if f and f[0] == _FRAG_T:
+                                k = _HDR_N.unpack_from(f, 5)[0]
+                                self.rx_frags += 1
+                                self.rx_frag_members += k
+                                n_log += k - 1
+                    self.rcvd_frames += n_log
+                    self.rcvd_bytes += consumed
+                    bb = self.blackbox
+                    if bb is not None:
+                        bb.note_ingress(n_log, consumed)
+                    if frames:
+                        if self.on_frames is not None:
+                            try:
+                                self.on_frames(frames)
+                            except Exception:
+                                log.exception("batch handler failed")
+                        else:
+                            for f in frames:
+                                self._dispatch(f)
+                del mv
             if consumed:
                 del buf[:consumed]
+
+    def _make_chunk(self, mv: memoryview, offs: np.ndarray,
+                    lens: np.ndarray, start: int,
+                    consumed: int) -> Optional[WireChunk]:
+        """SoA receive: package the whole consumed region as ONE
+        immutable blob + offset columns (no per-frame slicing) and
+        account it; delivery stays in the scan loop."""
+        if start:
+            offs = offs[start:]
+            lens = lens[start:]
+        if len(lens) and int(lens.min()) == 0:
+            keep = lens > 0
+            offs = offs[keep]
+            lens = lens[keep]
+        self.rcvd_bytes += consumed
+        if not len(offs):
+            return None
+        blob = bytes(mv[:consumed])
+        ck = WireChunk(blob, offs, lens)
+        n_log = len(offs)
+        for i in np.flatnonzero(ck.types == _FRAG_T).tolist():
+            k = _HDR_N.unpack_from(blob, int(offs[i]) + 5)[0]
+            self.rx_frags += 1
+            self.rx_frag_members += k
+            n_log += k - 1
+        self.rcvd_frames += n_log
+        bb = self.blackbox
+        if bb is not None:
+            bb.note_ingress(n_log, consumed)
+        return ck
 
     def _dispatch(self, frame: bytes) -> None:
         """on_frame with a crash guard: one malformed/unknown frame must
@@ -550,6 +792,13 @@ class Transport:
             },
             "reconnects": self.reconnects,
             "connect_failures": self.connect_failures,
+            "tx_writes": self.tx_writes,
+            "rx_reads": self.rx_reads,
+            "tx_frags": self.tx_frags,
+            "tx_frag_members": self.tx_frag_members,
+            "rx_frags": self.rx_frags,
+            "rx_frag_members": self.rx_frag_members,
+            "peer_wire": dict(self.peer_wire),
         }
 
     def stats(self) -> str:

@@ -39,7 +39,7 @@ from typing import Dict, List, Optional, Set, Tuple
 import numpy as np
 
 from gigapaxos_tpu import native
-from gigapaxos_tpu.net.transport import Transport
+from gigapaxos_tpu.net.transport import Transport, WireChunk
 from gigapaxos_tpu.ops.types import (NODE_BITS, NODE_MASK, NO_BALLOT,
                                      NO_SLOT, pack_ballot, unpack_ballot)
 from gigapaxos_tpu.paxos import packets as pkt
@@ -70,6 +70,26 @@ FLAG_MISSING = 4
 FLAG_SAMPLED = pkt.Request.FLAG_SAMPLED
 
 _UNSET = object()  # cache-miss sentinel (None is a valid cached value)
+
+# wire-plane frame types the intake path special-cases (hot-loop
+# constants: one enum lookup at import, not per frame)
+_FRAG_T = int(pkt.PacketType.FRAG)
+_HELLO_T = int(pkt.PacketType.WIRE_HELLO)
+_REQ_T = int(pkt.PacketType.REQUEST)
+
+
+def _frames_in(item) -> int:
+    """Frame count of one intake-queue item: a raw frame or packet
+    object counts 1, a read-chunk list counts its members, a WireChunk
+    counts its scanned frames."""
+    if isinstance(item, list):
+        n = 0
+        for x in item:
+            n += _frames_in(x)
+        return n
+    if type(item) is WireChunk:
+        return len(item)
+    return 1
 
 
 def _no_cpu_clock():
@@ -511,7 +531,13 @@ class PaxosNode:
         self._stopping = False
         self.transport = Transport(
             node_id, addr_map[node_id], addr_map, self._on_frame,
-            on_frames=self._on_frames)
+            on_frames=self._on_frames,
+            # wire-plane aggregation (PC.WIRE_*, read once at boot like
+            # the stats knobs): per-peer FRAG coalescing on the emit
+            # side, SoA WireChunk delivery on the receive side
+            wire_coalesce=bool(Config.get(PC.WIRE_COALESCE)),
+            coalesce_min=int(Config.get(PC.WIRE_COALESCE_MIN)),
+            rx_chunks=bool(Config.get(PC.WIRE_SOA_RX)))
         # flight recorder (PC.BLACKBOX_*; gigapaxos_tpu/blackbox/):
         # the per-node capture ring, armed at construction so every
         # hook site (decode boundary, engine wave, WAL append,
@@ -1106,17 +1132,31 @@ class PaxosNode:
         parser in one C call; everything else decodes per frame."""
         out = []
         req_frames: List[bytes] = []
+        # request groups that arrived as WireChunk SoA columns:
+        # (blob, offs, lens) — when a batch's requests all came from
+        # ONE chunk they parse straight out of the receive blob (no
+        # join, no per-frame slicing)
+        req_chunks: List[Tuple] = []
         # flight recorder: the decode boundary is where the capture
         # sees EVERY packet the engine will consume — wire frames by
         # reference (zero copy), self-routed objects re-encoded at
         # their consumption point, so the F-record stream is a complete
-        # deterministic replay input with live batch boundaries
+        # deterministic replay input with live batch boundaries.  FRAG
+        # super-frames are captured as their post-split canonical
+        # members, so capture->replay stays bit-for-bit regardless of
+        # how the wire coalesced them.
         bb = self.blackbox
         cap: Optional[List[bytes]] = [] if bb is not None else None
         for item in batch:
             if isinstance(item, list):
                 # chunk of frames (batch intake): flatten inline
                 batch.extend(item)
+                continue
+            if type(item) is WireChunk:
+                rc = self._decode_chunk(item, batch, out, req_frames,
+                                        cap)
+                if rc is not None:
+                    req_chunks.append(rc)
                 continue
             if not isinstance(item, (bytes, bytearray, memoryview)):
                 out.append(item)  # self-routed object
@@ -1128,6 +1168,16 @@ class PaxosNode:
                             "blackbox: un-encodable self-routed %s",
                             type(item).__name__)
                 continue
+            if len(item) and item[0] == _FRAG_T:
+                # split first: members re-enter this loop as canonical
+                # frames (capture and decode see post-split frames)
+                try:
+                    batch.extend(pkt.Frag.split(item))
+                except Exception:
+                    log.exception("dropping malformed super-frame")
+                continue
+            if len(item) and item[0] == _HELLO_T:
+                continue  # stray version hello: link control, not data
             if cap is not None:
                 cap.append(item)
             if len(item) == 0:
@@ -1140,6 +1190,18 @@ class PaxosNode:
                 except Exception:
                     log.exception("dropping malformed frame type %d",
                                   item[0])
+        if req_frames:
+            if len(req_chunks) == 1 and \
+                    len(req_frames) == len(req_chunks[0][1]):
+                # zero-copy fast path: every request in the batch sits
+                # in one receive blob — one native parse, no join
+                blob, offs, lens = req_chunks[0]
+                try:
+                    out.append(_ReqSoA(*native.parse_requests(
+                        blob, offs, lens)))
+                    req_frames = []
+                except ValueError:
+                    pass  # fall through to the join path below
         if req_frames:
             try:
                 buf = b"".join(req_frames)
@@ -1164,6 +1226,57 @@ class PaxosNode:
                            RequestInstrumenter.current_wave(),
                            self._wal_seg(), cap)
         return out
+
+    def _decode_chunk(self, ck: WireChunk, batch: List, out: List,
+                      req_frames: List,
+                      cap: Optional[List]) -> Optional[Tuple]:
+        """SoA intake for one :class:`WireChunk`: classify every frame
+        in the chunk with ONE vectorized pass over its type column,
+        decode non-request frames from zero-copy ``memoryview`` slices
+        of the receive blob, and return the REQUEST columns as a
+        ``(blob, offs, lens)`` descriptor so the caller can parse them
+        natively without a join.  FRAG super-frames re-enter ``batch``
+        as canonical member frames.  When the flight recorder is armed
+        the frames are captured as ``bytes`` copies (the capture ring's
+        byte accounting must not pin whole receive blobs)."""
+        blob = ck.blob
+        mv = memoryview(blob)
+        types = ck.types
+        offs = ck.offs
+        lens = ck.lens
+        sel = types == _REQ_T
+        nreq = int(sel.sum())
+        if nreq:
+            for i in np.flatnonzero(sel).tolist():
+                o = int(offs[i])
+                f = mv[o:o + int(lens[i])]
+                req_frames.append(f)
+                if cap is not None:
+                    cap.append(bytes(f))
+        if nreq == len(types):
+            return (blob, offs, lens)
+        for i in np.flatnonzero(~sel).tolist():
+            o = int(offs[i])
+            ln = int(lens[i])
+            t = int(types[i])
+            if t == _FRAG_T:
+                try:
+                    batch.extend(pkt.Frag.split(mv[o:o + ln]))
+                except Exception:
+                    log.exception("dropping malformed super-frame")
+                continue
+            if t == _HELLO_T:
+                continue
+            f = mv[o:o + ln]
+            if cap is not None:
+                cap.append(bytes(f))
+            try:
+                out.append(pkt.decode(f))
+            except Exception:
+                log.exception("dropping malformed frame type %d", t)
+        if nreq:
+            return (blob, offs[sel], lens[sel])
+        return None
 
     def _was_executed(self, rid: int) -> bool:
         """At-most-once membership across both dedupe generations."""
@@ -1299,7 +1412,7 @@ class PaxosNode:
             # intake one item can be a whole read chunk, and an
             # uncounted fill would build multi-second mega-batches that
             # starve _tick (elections, re-drive, catch-up)
-            n_frames = len(first) if isinstance(first, list) else 1
+            n_frames = _frames_in(first)
             while n_frames < self.batch_size:
                 try:
                     nxt = self._inq.get_nowait()
@@ -1309,7 +1422,7 @@ class PaxosNode:
                     self._stopping = True
                     break
                 batch.append(nxt)
-                n_frames += len(nxt) if isinstance(nxt, list) else 1
+                n_frames += _frames_in(nxt)
             prev_items = n_frames
             self._backlog_est = int(
                 self._inq.qsize() * n_frames / max(1, len(batch)))
@@ -1453,7 +1566,7 @@ class PaxosNode:
                         self.batch_coalesce > 0:
                     time.sleep(self.batch_coalesce)
                 batch = [first]
-                n_frames = len(first) if isinstance(first, list) else 1
+                n_frames = _frames_in(first)
                 while n_frames < self.batch_size:
                     try:
                         nxt = self._inq.get_nowait()
@@ -1463,7 +1576,7 @@ class PaxosNode:
                         self._stopping = True
                         break
                     batch.append(nxt)
-                    n_frames += len(nxt) if isinstance(nxt, list) else 1
+                    n_frames += _frames_in(nxt)
                 prev_items = n_frames
                 self._backlog_est = int(
                     self._inq.qsize() * n_frames / max(1, len(batch)))
@@ -1666,7 +1779,7 @@ class PaxosNode:
                         self.batch_coalesce > 0:
                     time.sleep(self.batch_coalesce)
                 batch = [first]
-                n_frames = len(first) if isinstance(first, list) else 1
+                n_frames = _frames_in(first)
                 while n_frames < self.batch_size:
                     try:
                         nxt = self._inq.get_nowait()
@@ -1676,7 +1789,7 @@ class PaxosNode:
                         self._stopping = True
                         break
                     batch.append(nxt)
-                    n_frames += len(nxt) if isinstance(nxt, list) else 1
+                    n_frames += _frames_in(nxt)
                 prev_items = n_frames
                 self._backlog_est = int(
                     self._inq.qsize() * n_frames / max(1, len(batch)))
@@ -2346,6 +2459,19 @@ class PaxosNode:
             },
             "net": self.transport.metrics(),
         }
+        # wire-efficiency derived metrics (PR 13): total wire bytes and
+        # writer/reader calls (the syscall proxy) amortized per decided
+        # slot — the two numbers the wire-aggregation plane moves
+        net = out["net"]
+        dec = out["counters"]["decided"]
+        if dec:
+            net["bytes_per_decision"] = round(
+                (net["tx_bytes"] + net["rx_bytes"]) / dec, 2)
+            net["syscalls_per_decision"] = round(
+                (net["tx_writes"] + net["rx_reads"]) / dec, 4)
+        else:
+            net["bytes_per_decision"] = 0.0
+            net["syscalls_per_decision"] = 0.0
         if include_profiler:
             # consensus-health aggregates (GET /groups has the per-
             # group detail; these are the per-scrape node rollups).

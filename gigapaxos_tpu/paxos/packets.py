@@ -73,6 +73,8 @@ class PacketType(IntEnum):
     CHUNK = 18            # large-frame chunking (LargeCheckpointer analog)
     PREPARE_BATCH = 19    # mass failover: n phase-1s in one frame
     PREPARE_REPLY_BATCH = 20
+    FRAG = 21             # per-peer super-frame (wire aggregation)
+    WIRE_HELLO = 22       # wire-format version announcement
 
 
 _HDR = struct.Struct("<BII")  # type, sender (u32, matches the transport's
@@ -838,3 +840,449 @@ def shard_split(obj, shards: int) -> Dict[int, object]:
         else:
             raise TypeError(f"shard_split: unsupported {t.__name__}")
     return out
+
+
+# --------------------------------------------------------------------------
+# wire-plane aggregation: FRAG super-frames + version hello
+# --------------------------------------------------------------------------
+#
+# HT-Paxos-style per-peer aggregation (arXiv:1407.1237): the emit stage
+# coalesces every frame bound for one peer in a wave into ONE wire frame
+# — a FRAG container whose member headers are delta-encoded against the
+# previous member (same type/sender/n_items collapse to a flags byte)
+# and whose hot SoA bodies column-compress when their id columns follow
+# the steady-state pattern (constant gkey/ballot, consecutive slots,
+# fixed-size payload blobs).  Reconstruction is LOSSLESS: ``Frag.split``
+# returns the exact canonical member frames byte-for-byte, so chaos
+# verdicts, blackbox captures, and decode all operate on unchanged
+# frames downstream.
+
+WIRE_VERSION = 1
+
+_U16 = struct.Struct("<H")
+_U32 = struct.Struct("<I")
+_U64 = struct.Struct("<Q")
+_I32 = struct.Struct("<i")
+
+# member-header delta flags (vs the previous member in the container)
+_M_TYPE = 1     # type differs -> u8 follows
+_M_SENDER = 2   # sender differs -> u32 follows
+_M_NITEMS = 4   # n_items differs -> uvarint follows
+_M_PACKED = 8   # body is column-packed (typed SoA compressor)
+_M_XOR = 16     # body is XOR-sparse vs the previous member's raw body
+
+# packed-column flags (first body byte when _M_PACKED): const columns
+# ship one scalar, delta columns ship the base of ``c0 + arange(n)``
+_C_GKEY = 1     # gkey constant -> u64
+_C_SLOT = 2     # slot == slot0 + i -> i32
+_C_BAL = 4      # ballot constant -> i32
+_C_RLO = 8      # req_lo == rlo0 + i -> i32
+_C_RHI = 16     # req_hi == rhi0 + i -> i32
+_C_ACK = 32     # acked constant -> u8
+_C_BLOB = 64    # payload blobs all equal length -> uvarint L + raw
+_C_BLOBX = 128  # fixed-size blobs, XOR-sparse between consecutive rows
+
+
+def _uvarint(x: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = x & 0x7F
+        x >>= 7
+        if x:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _read_uvarint(mv, o: int) -> Tuple[int, int]:
+    x = 0
+    shift = 0
+    while True:
+        b = mv[o]
+        o += 1
+        x |= (b & 0x7F) << shift
+        if not (b & 0x80):
+            return x, o
+        shift += 7
+        if shift > 63:
+            raise ValueError("uvarint overflow")
+
+
+def _xor_sparse(prev, cur) -> Optional[bytes]:
+    """Body-vs-previous-body sparse delta: coalesced same-type frames
+    (e.g. a wave of per-request proposals from one client) differ in a
+    handful of bytes — ship only those.  u16 count + u16 positions +
+    u8 values; None when not strictly smaller than the raw body."""
+    d = np.frombuffer(prev, np.uint8) ^ np.frombuffer(cur, np.uint8)
+    nz = np.flatnonzero(d)
+    if len(cur) > 0xFFFF or 2 + 3 * nz.size >= len(cur):
+        return None
+    return (_U16.pack(nz.size) + nz.astype("<u2").tobytes()
+            + d[nz].tobytes())
+
+
+def _xor_apply(prev, data) -> bytes:
+    cnt = _U16.unpack_from(data, 0)[0]
+    if len(data) != 2 + 3 * cnt:
+        raise ValueError("bad xor member")
+    pos = np.frombuffer(data, "<u2", cnt, 2).astype(np.int64)
+    out = np.frombuffer(prev, np.uint8).copy()
+    if cnt and int(pos.max()) >= out.size:
+        raise ValueError("bad xor member")
+    out[pos] ^= np.frombuffer(data, np.uint8, cnt, 2 + 2 * cnt)
+    return out.tobytes()
+
+
+def _pack_gsb(n: int, body: memoryview) -> Tuple[int, bytearray]:
+    """Compress the leading gkey/slot/bal columns shared by the hot
+    SoA packets (gkey const, slot consecutive, ballot const)."""
+    g = np.frombuffer(body[:8 * n], np.uint64)
+    s = np.frombuffer(body[8 * n:12 * n], np.int32)
+    b = np.frombuffer(body[12 * n:16 * n], np.int32)
+    cf = 0
+    out = bytearray()
+    if (g == g[0]).all():
+        cf |= _C_GKEY
+        out += _U64.pack(int(g[0]))
+    else:
+        out += bytes(body[:8 * n])
+    if (np.diff(s.astype(np.int64)) == 1).all():
+        cf |= _C_SLOT
+        out += _I32.pack(int(s[0]))
+    else:
+        out += bytes(body[8 * n:12 * n])
+    if (b == b[0]).all():
+        cf |= _C_BAL
+        out += _I32.pack(int(b[0]))
+    else:
+        out += bytes(body[12 * n:16 * n])
+    return cf, out
+
+
+def _pack_lohi(n: int, body: memoryview, o: int,
+               out: bytearray) -> int:
+    """req_lo/req_hi columns: both are consecutive runs in the
+    steady state (one request range per window entry)."""
+    cf = 0
+    lo = np.frombuffer(body[o:o + 4 * n], np.int32)
+    hi = np.frombuffer(body[o + 4 * n:o + 8 * n], np.int32)
+    if (np.diff(lo.astype(np.int64)) == 1).all():
+        cf |= _C_RLO
+        out += _I32.pack(int(lo[0]))
+    else:
+        out += bytes(body[o:o + 4 * n])
+    if (np.diff(hi.astype(np.int64)) == 1).all():
+        cf |= _C_RHI
+        out += _I32.pack(int(hi[0]))
+    else:
+        out += bytes(body[o + 4 * n:o + 8 * n])
+    return cf
+
+
+def _read_gsb(cf: int, n: int, mv, o: int) -> Tuple[bytes, int]:
+    if cf & _C_GKEY:
+        g = np.full(n, _U64.unpack_from(mv, o)[0], np.uint64).tobytes()
+        o += 8
+    else:
+        g = bytes(mv[o:o + 8 * n])
+        o += 8 * n
+    if cf & _C_SLOT:
+        s0 = _I32.unpack_from(mv, o)[0]
+        o += 4
+        s = (np.int64(s0) + np.arange(n, dtype=np.int64)).astype(
+            np.int32).tobytes()
+    else:
+        s = bytes(mv[o:o + 4 * n])
+        o += 4 * n
+    if cf & _C_BAL:
+        b = np.full(n, _I32.unpack_from(mv, o)[0], np.int32).tobytes()
+        o += 4
+    else:
+        b = bytes(mv[o:o + 4 * n])
+        o += 4 * n
+    return g + s + b, o
+
+
+def _read_lohi(cf: int, n: int, mv, o: int) -> Tuple[bytes, int]:
+    ar = np.arange(n, dtype=np.int64)
+    if cf & _C_RLO:
+        lo = (np.int64(_I32.unpack_from(mv, o)[0]) + ar).astype(
+            np.int32).tobytes()
+        o += 4
+    else:
+        lo = bytes(mv[o:o + 4 * n])
+        o += 4 * n
+    if cf & _C_RHI:
+        hi = (np.int64(_I32.unpack_from(mv, o)[0]) + ar).astype(
+            np.int32).tobytes()
+        o += 4
+    else:
+        hi = bytes(mv[o:o + 4 * n])
+        o += 4 * n
+    return lo + hi, o
+
+
+def _pack_accept(n: int, body: memoryview) -> Optional[bytes]:
+    if n < 2 or len(body) < 24 * n + 4 * (n + 1):
+        return None
+    cf, out = _pack_gsb(n, body)
+    cf |= _pack_lohi(n, body, 16 * n, out)
+    offs = np.frombuffer(body[24 * n:24 * n + 4 * (n + 1)], np.uint32)
+    sizes = np.diff(offs.astype(np.int64))
+    if int(sizes.min()) == int(sizes.max()):
+        size = int(sizes[0])
+        blob = body[24 * n + 4 * (n + 1):]
+        packed = _pack_blob_rows(n, size, blob) if size else None
+        if packed is not None:
+            cf |= _C_BLOBX
+            out += packed
+        else:
+            cf |= _C_BLOB
+            out += _uvarint(size)
+            out += bytes(blob)
+    else:
+        out += bytes(body[24 * n:])
+    return bytes((cf,)) + bytes(out)
+
+
+def _pack_blob_rows(n: int, size: int,
+                    blob: memoryview) -> Optional[bytes]:
+    """Fixed-size blob rows as first-row + XOR-sparse row deltas:
+    consecutive window entries carry near-identical payload records
+    (same client, sequential request ids), so each row differs from
+    its neighbour in 1-3 bytes.  uvarint L, row 0 raw, u8 per-row
+    nonzero counts, then column indexes (u8 when L <= 255 else u16)
+    and values.  None when not smaller than the raw blob bytes."""
+    if size > 0xFFFF or len(blob) != n * size:
+        return None
+    m = np.frombuffer(blob, np.uint8).reshape(n, size)
+    d = m[1:] ^ m[:-1]
+    rows, cols = np.nonzero(d)
+    counts = np.bincount(rows, minlength=n - 1)
+    if counts.size and int(counts.max()) > 255:
+        return None
+    cw = 1 if size <= 255 else 2
+    if size + (n - 1) + rows.size * (cw + 1) >= n * size:
+        return None
+    return (_uvarint(size) + m[0].tobytes()
+            + counts.astype(np.uint8).tobytes()
+            + cols.astype(np.uint8 if cw == 1 else "<u2").tobytes()
+            + d[rows, cols].tobytes())
+
+
+def _unpack_blob_rows(n: int, mv, o: int) -> Tuple[int, bytes, int]:
+    """-> (row size, raw blob bytes, next offset)."""
+    size, o = _read_uvarint(mv, o)
+    cw = 1 if size <= 255 else 2
+    first = np.frombuffer(bytes(mv[o:o + size]), np.uint8)
+    o += size
+    counts = np.frombuffer(bytes(mv[o:o + n - 1]), np.uint8)
+    o += n - 1
+    nnz = int(counts.sum())
+    cols = np.frombuffer(bytes(mv[o:o + nnz * cw]),
+                         np.uint8 if cw == 1 else "<u2").astype(np.int64)
+    o += nnz * cw
+    vals = np.frombuffer(bytes(mv[o:o + nnz]), np.uint8)
+    o += nnz
+    if first.size != size or counts.size != n - 1 or \
+            cols.size != nnz or vals.size != nnz or \
+            (nnz and int(cols.max()) >= size):
+        raise ValueError("truncated blob rows")
+    m = np.zeros((n, size), np.uint8)
+    m[0] = first
+    r = np.repeat(np.arange(1, n, dtype=np.int64),
+                  counts.astype(np.int64))
+    m[r, cols] = vals
+    return size, np.bitwise_xor.accumulate(m, axis=0).tobytes(), o
+
+
+def _unpack_accept(n: int, mv) -> bytes:
+    cf = mv[0]
+    gsb, o = _read_gsb(cf, n, mv, 1)
+    lohi, o = _read_lohi(cf, n, mv, o)
+    if cf & _C_BLOBX:
+        size, blob, o = _unpack_blob_rows(n, mv, o)
+        offs = (np.arange(n + 1, dtype=np.uint64)
+                * np.uint64(size)).astype(np.uint32)
+        return gsb + lohi + offs.tobytes() + blob
+    if cf & _C_BLOB:
+        size, o = _read_uvarint(mv, o)
+        offs = (np.arange(n + 1, dtype=np.uint64)
+                * np.uint64(size)).astype(np.uint32)
+        return gsb + lohi + offs.tobytes() + bytes(mv[o:o + n * size])
+    return gsb + lohi + bytes(mv[o:])
+
+
+def _pack_commit(n: int, body: memoryview) -> Optional[bytes]:
+    if n < 2 or len(body) != 24 * n:
+        return None
+    cf, out = _pack_gsb(n, body)
+    cf |= _pack_lohi(n, body, 16 * n, out)
+    return bytes((cf,)) + bytes(out)
+
+
+def _unpack_commit(n: int, mv) -> bytes:
+    cf = mv[0]
+    gsb, o = _read_gsb(cf, n, mv, 1)
+    lohi, _o = _read_lohi(cf, n, mv, o)
+    return gsb + lohi
+
+
+def _pack_reply(n: int, body: memoryview) -> Optional[bytes]:
+    if n < 2 or len(body) != 17 * n:
+        return None
+    cf, out = _pack_gsb(n, body)
+    a = np.frombuffer(body[16 * n:17 * n], np.uint8)
+    if (a == a[0]).all():
+        cf |= _C_ACK
+        out.append(int(a[0]))
+    else:
+        out += bytes(body[16 * n:])
+    return bytes((cf,)) + bytes(out)
+
+
+def _unpack_reply(n: int, mv) -> bytes:
+    cf = mv[0]
+    gsb, o = _read_gsb(cf, n, mv, 1)
+    if cf & _C_ACK:
+        return gsb + np.full(n, mv[o], np.uint8).tobytes()
+    return gsb + bytes(mv[o:o + n])
+
+
+_FRAG_PACKERS = {
+    int(PacketType.ACCEPT_BATCH): _pack_accept,
+    int(PacketType.ACCEPT_REPLY_BATCH): _pack_reply,
+    int(PacketType.COMMIT_BATCH): _pack_commit,
+}
+_FRAG_UNPACKERS = {
+    int(PacketType.ACCEPT_BATCH): _unpack_accept,
+    int(PacketType.ACCEPT_REPLY_BATCH): _unpack_reply,
+    int(PacketType.COMMIT_BATCH): _unpack_commit,
+}
+
+
+class Frag:
+    """Per-peer super-frame container (wire layout in README "Wire
+    format").  ``encode`` returns a scatter-gather parts list so the
+    transport can hand it to ``writelines`` without a join; ``split``
+    reconstructs the exact canonical member frames."""
+
+    TYPE = PacketType.FRAG
+
+    @classmethod
+    def encode(cls, sender: int,
+               frames: Sequence[bytes]) -> Tuple[list, int]:
+        parts: list = [b""]
+        total = _HDR.size + 1
+        ptype = 0
+        psender = sender
+        pn = 1
+        prev_body = None
+        for f in frames:
+            t, s, n = _HDR.unpack_from(f, 0)
+            body = memoryview(f)[_HDR.size:]
+            flags = 0
+            meta = bytearray(1)
+            if t != ptype:
+                flags |= _M_TYPE
+                meta.append(t)
+            if s != psender:
+                flags |= _M_SENDER
+                meta += _U32.pack(s)
+            if n != pn:
+                flags |= _M_NITEMS
+                meta += _uvarint(n)
+            payload = body
+            pk = _FRAG_PACKERS.get(t)
+            if pk is not None:
+                packed = pk(n, body)
+                if packed is not None and len(packed) < len(body):
+                    flags |= _M_PACKED
+                    payload = packed
+            if not (flags & _M_PACKED) and prev_body is not None \
+                    and t == ptype and len(body) == len(prev_body):
+                xs = _xor_sparse(prev_body, body)
+                if xs is not None:
+                    flags |= _M_XOR
+                    payload = xs
+            meta[0] = flags
+            meta += _uvarint(len(payload))
+            parts.append(bytes(meta))
+            parts.append(payload)
+            total += len(meta) + len(payload)
+            ptype, psender, pn = t, s, n
+            prev_body = body
+        parts[0] = (_HDR.pack(cls.TYPE, sender, len(frames))
+                    + bytes((WIRE_VERSION,)))
+        return parts, total
+
+    @classmethod
+    def split(cls, frame) -> List[bytes]:
+        mv = memoryview(frame)
+        _t, s, k = _HDR.unpack_from(mv, 0)
+        if mv[_HDR.size] > WIRE_VERSION:
+            raise ValueError("frag from a newer wire version")
+        o = _HDR.size + 1
+        end = len(mv)
+        ptype = 0
+        psender = s
+        pn = 1
+        prev_raw = None
+        out: List[bytes] = []
+        for _ in range(k):
+            flags = mv[o]
+            o += 1
+            if flags & _M_TYPE:
+                ptype = mv[o]
+                o += 1
+            if flags & _M_SENDER:
+                psender = _U32.unpack_from(mv, o)[0]
+                o += 4
+            if flags & _M_NITEMS:
+                pn, o = _read_uvarint(mv, o)
+            blen, o = _read_uvarint(mv, o)
+            if o + blen > end:
+                raise ValueError("truncated frag member")
+            body = mv[o:o + blen]
+            o += blen
+            if flags & _M_PACKED:
+                raw = _FRAG_UNPACKERS[ptype](pn, body)
+            elif flags & _M_XOR:
+                if prev_raw is None:
+                    raise ValueError("xor member without predecessor")
+                raw = _xor_apply(prev_raw, body)
+            else:
+                raw = bytes(body)
+            out.append(_HDR.pack(ptype, psender, pn) + raw)
+            prev_raw = raw
+        return out
+
+
+_PACK_MIN_BYTES = 96
+
+
+def packable(frame) -> bool:
+    """True when a LONE frame is still worth wrapping in a 1-member
+    FRAG: its type has a column packer, it carries >= 2 items, and it
+    is big enough that the SoA collapse pays for the container
+    overhead.  The transport's emit coalescer uses this so single-
+    frame waves (e.g. a peer's reply batch) still column-compress."""
+    return (len(frame) >= _PACK_MIN_BYTES
+            and frame[0] in _FRAG_PACKERS
+            and _U32.unpack_from(frame, 5)[0] >= 2)
+
+
+def wire_hello(sender: int) -> bytes:
+    """Version-announcement frame: first frame on every outbound
+    connection of a coalescing node (README "Wire format")."""
+    return (_HDR.pack(PacketType.WIRE_HELLO, sender, 1)
+            + bytes((WIRE_VERSION,)))
+
+
+def parse_wire_hello(frame: bytes) -> Tuple[int, int]:
+    """-> (sender, wire_version); raises on a non-hello frame."""
+    t, s, _n = _HDR.unpack_from(frame, 0)
+    if t != PacketType.WIRE_HELLO or len(frame) < _HDR.size + 1:
+        raise ValueError("not a wire hello")
+    return s, frame[_HDR.size]

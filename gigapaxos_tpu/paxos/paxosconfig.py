@@ -187,3 +187,18 @@ class PC(ConfigKey):
     # auto-dump when a sampled request enters the slow-request log
     # (requires SLOW_TRACE_S > 0 and the trace plane enabled)
     BLACKBOX_ON_SLOW = False
+    # wire-plane aggregation (HT-Paxos-style per-peer
+    # coalescing, arXiv:1407.1237).  WIRE_COALESCE packs every frame a
+    # worker batch emits toward one peer into a single FRAG super-frame
+    # (delta-encoded member headers, column-compressed hot SoA bodies)
+    # written with one vectorized writelines call — but only toward
+    # peers that announced a compatible wire version via WIRE_HELLO;
+    # un-negotiated (old) peers keep the plain per-frame path, and OFF
+    # is byte-for-byte the old wire format.  Read once at node boot.
+    WIRE_COALESCE = True
+    # minimum same-peer frames in an emit batch worth a FRAG container
+    # (below it, plain sends win — the container header costs ~10B)
+    WIRE_COALESCE_MIN = 2
+    # zero-copy SoA receive: deliver each read chunk as ONE WireChunk
+    # (blob + offset/type columns) instead of per-frame bytes slices
+    WIRE_SOA_RX = True

@@ -152,11 +152,15 @@ class PaxosEmulation:
 
     def run_load_fast(self, n_requests: int, concurrency: int = 512,
                       payload: bytes = b"x", timeout: float = 30.0,
-                      client_id: int = 1 << 20) -> Dict:
+                      client_id: int = 1 << 20,
+                      entry_shift: int = 0) -> Dict:
         """Windowed pipelined load (ref TESTPaxosClient; see
         testing/loadgen.py) — the measurement path for the throughput
         bench; ``run_load`` below is the per-request-client path used by
-        correctness tests."""
+        correctness tests.  ``entry_shift`` rotates each group's entry
+        node away from its coordinator (shift 1 = next member), forcing
+        the per-request forwarding path — the wire-bench uses it to
+        exercise peer-to-peer proposal traffic."""
         from gigapaxos_tpu.testing.loadgen import run_fast_load_sync
         live = sorted(i for i, nd in self.nodes.items() if nd is not None)
         servers = [self.addr_map[i] for i in live]
@@ -165,7 +169,7 @@ class PaxosEmulation:
         from gigapaxos_tpu.paxos.packets import group_key
         for g in self.groups:
             mem = self.members_of(g)
-            coord = mem[group_key(g) % len(mem)]
+            coord = mem[(group_key(g) + entry_shift) % len(mem)]
             route.append(live.index(coord) if coord in live else 0)
         return run_fast_load_sync(
             servers, self.groups, n_requests, concurrency=concurrency,

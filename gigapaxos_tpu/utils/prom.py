@@ -182,10 +182,30 @@ def render_prometheus(m: dict, prefix: str = "gp") -> str:
             ("reconnects", "net_reconnects",
              "peer reconnect attempts after a lost connection"),
             ("connect_failures", "net_connect_failures",
-             "failed peer connect attempts")):
+             "failed peer connect attempts"),
+            ("tx_writes", "net_tx_writes",
+             "writer calls on the send path (syscall proxy)"),
+            ("rx_reads", "net_rx_reads",
+             "socket reads on the receive path (syscall proxy)"),
+            ("tx_frags", "net_tx_frags",
+             "FRAG super-frames sent (wire aggregation)"),
+            ("tx_frag_members", "net_tx_frag_members",
+             "frames that traveled inside sent FRAG super-frames"),
+            ("rx_frags", "net_rx_frags",
+             "FRAG super-frames received"),
+            ("rx_frag_members", "net_rx_frag_members",
+             "frames that arrived inside FRAG super-frames")):
         if key in net:
             w.family(f"{p}_{name}_total", "counter", help_,
                      [(None, net[key])])
+    for key, name, help_ in (
+            ("bytes_per_decision", "net_bytes_per_decision",
+             "total wire bytes (tx+rx) amortized per decided slot"),
+            ("syscalls_per_decision", "net_syscalls_per_decision",
+             "writer/reader calls (tx+rx syscall proxy) amortized "
+             "per decided slot")):
+        if key in net:
+            w.family(f"{p}_{name}", "gauge", help_, [(None, net[key])])
     drops = net.get("drops")
     if drops:
         w.family(f"{p}_net_dropped_frames_total", "counter",

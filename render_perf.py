@@ -300,6 +300,7 @@ def render() -> str:
             " — the window knob, not the engine, sets the single-group "
             "ceiling |")
 
+    out.extend(_wire_rows())
     out.extend(_chaos_rows())
     out.extend(_blackbox_rows())
     out.extend(_analysis_rows())
@@ -307,6 +308,33 @@ def render() -> str:
     out.append("")
     out.append(END)
     return "\n".join(out)
+
+
+def _wire_rows():
+    """Wire-efficiency row from the tracked ``BENCH_WIRE.json``
+    (`python bench.py --wire-ab`): bytes/decision and syscalls/decision
+    with the wire-aggregation plane off vs on, same workload.  The
+    off arm is byte-for-byte the pre-aggregation wire, so the ratios
+    ARE the plane's measured win."""
+    art = _load("BENCH_WIRE.json")
+    if not art or "off" not in art:
+        return []
+    offw = art["off"]["wire"]
+    onw = art["on"]["wire"]
+    return [
+        "| Wire-plane aggregation A/B (per-peer FRAG coalescing + SoA "
+        f"column packing; 3 replicas, {art.get('groups')} hot group(s), "
+        f"W={art.get('window')}, depth {art.get('depth')}, "
+        "`BENCH_WIRE.json`) | "
+        f"bytes/decision {offw.get('bytes_per_decision')} → "
+        f"{onw.get('bytes_per_decision')} "
+        f"(**{art.get('bytes_per_decision_ratio')}×**), "
+        f"syscalls/decision {offw.get('syscalls_per_decision')} → "
+        f"{onw.get('syscalls_per_decision')} "
+        f"(**{art.get('syscalls_per_decision_ratio')}×**); "
+        f"{onw.get('tx_frag_members')} frames coalesced into "
+        f"{onw.get('tx_frags')} super-frames; recorded "
+        f"{art.get('recorded_at')} |"]
 
 
 def _chaos_rows():

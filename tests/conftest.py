@@ -45,6 +45,9 @@ from gigapaxos_tpu.testing.harness import tscale  # noqa: E402,F401
 
 @pytest.fixture(autouse=True)
 def _clean_config():
+    # covers every PC.* knob family a test may set — including the
+    # PC.WIRE_* wire-plane knobs, which nodes read once at boot, so a
+    # leaked override would silently reshape every later cluster test
     from gigapaxos_tpu.utils.config import Config
     yield
     Config.clear()

@@ -397,6 +397,56 @@ def test_invariant_violation_auto_dumps_and_replays(tmp_path,
         assert not rep["group_mismatches"]
 
 
+def test_capture_replay_parity_with_wire_coalescing(tmp_path):
+    """Wire-plane compat (PR 13): a 3-node chaos drill with FRAG
+    coalescing explicitly ON still captures replayable rings — the
+    F-stream records post-split canonical frames, so super-frames on
+    the wire change nothing about the replay digest.  The test also
+    proves frags actually flowed (it would be vacuous otherwise)."""
+    from gigapaxos_tpu.testing.harness import PaxosEmulation
+
+    Config.set(PC.BLACKBOX_MB, 8)
+    Config.set(PC.BLACKBOX_S, 0.0)
+    Config.set(PC.WIRE_COALESCE, True)
+    Config.set(PC.WIRE_COALESCE_MIN, 2)
+    ChaosPlane.reset()
+    # no base delay: a delayed member is released outside the frag
+    # group, so an all-delay link would starve the coalescer the test
+    # exists to exercise; reorder still perturbs a 20% slice
+    ChaosPlane.configure(seed=23, enabled=True)
+    ChaosPlane.set_link(None, None, reorder_p=0.2)
+    emu = PaxosEmulation(str(tmp_path), n_nodes=3, n_groups=4,
+                         backend="native", app_cls=CounterApp,
+                         capacity=1 << 10, window=16)
+    try:
+        res = emu.run_load(60, concurrency=12, timeout=tscale(20))
+        assert res["ok"] > 0, res
+        ChaosPlane.clear()
+        _quiesce(emu)
+        tx = sum(nd.transport.tx_frags for nd in emu.nodes.values())
+        rx = sum(nd.transport.rx_frags for nd in emu.nodes.values())
+        assert tx > 0 and rx > 0, (tx, rx)
+        for i, nd in sorted(emu.nodes.items()):
+            path = nd.blackbox.dump("wire_parity_test")
+            recs, _man = read_capture(path)
+            # the F-stream carries canonical frames only — never the
+            # FRAG container or the version hello
+            import gigapaxos_tpu.paxos.packets as pkt
+            for r in recs:
+                if r["t"] == "F":
+                    for f in r["frames"]:
+                        assert f[0] not in (
+                            int(pkt.PacketType.FRAG),
+                            int(pkt.PacketType.WIRE_HELLO)), (i, f[0])
+            rep = replay_capture(path)
+            assert rep["verdict"] == "MATCH", (i, rep)
+            assert not rep["partial"]
+            assert rep["waves_replayed"] > 0
+    finally:
+        emu.stop()
+        ChaosPlane.reset()
+
+
 def test_record_demo_roundtrip_sharded(tmp_path):
     """The offline capture generator (reference.gpbb's producer) stays
     replayable on the sharded engine path too."""

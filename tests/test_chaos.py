@@ -333,3 +333,59 @@ def test_scenario_replays_identically(tmp_path):
         [s["event"] for s in b["stages"]]
     assert a["schedule_fingerprint"] != c["schedule_fingerprint"]
     assert a["ok"] and b["ok"] and c["ok"]
+
+
+@pytest.mark.smoke
+def test_wire_coalescing_keeps_schedule_fingerprint():
+    """PR 13 contract: the FRAG coalescer serves the chaos gate per
+    MEMBER in send order, so a coalesced run consumes the exact same
+    verdict stream — schedule_fingerprint() is identical to the
+    un-coalesced run at the same seed (and still diverges across
+    seeds)."""
+    def run(coalesce, seed):
+        state = {}
+
+        async def main():
+            ChaosPlane.reset()
+            # drop-only schedule: a delayed member leaves the frag
+            # group (it travels alone later), so an all-delay link
+            # would never build a container to compare
+            ChaosPlane.configure(seed=seed, enabled=True)
+            ChaosPlane.set_link(None, None, drop_p=0.25)
+            t0 = Transport(0, ("127.0.0.1", 0), {},
+                           on_frame=lambda f: None,
+                           wire_coalesce=coalesce)
+            await t0.start()
+            t1 = Transport(1, ("127.0.0.1", 0),
+                           {0: ("127.0.0.1", t0.port)},
+                           on_frame=lambda f: None,
+                           wire_coalesce=coalesce, coalesce_min=2)
+            await t1.start()
+            if coalesce:
+                # skip the hello round-trip; the verdict stream under
+                # test starts at the first send_many either way
+                t1.peer_wire[0] = pk.WIRE_VERSION
+            frames = [pk.Proposal(sender=1, gkey=9, req_id=7000 + i,
+                                  entry=2, flags=0,
+                                  payload=b"chaos-parity").encode()
+                      for i in range(40)]
+            # verdicts are consumed synchronously at send time, in
+            # member order — waves of 5 exercise both frag paths
+            for i in range(0, len(frames), 5):
+                t1.send_many([(0, f, False, 1)
+                              for f in frames[i:i + 5]])
+            state["fp"] = ChaosPlane.schedule_fingerprint([(1, 0)])
+            state["tx_frags"] = t1.tx_frags
+            await t1.stop()
+            await t0.stop()
+            ChaosPlane.reset()
+
+        asyncio.run(main())
+        return state
+
+    plain = run(False, seed=31)
+    frag = run(True, seed=31)
+    other = run(True, seed=32)
+    assert plain["tx_frags"] == 0 and frag["tx_frags"] > 0
+    assert frag["fp"] == plain["fp"]
+    assert other["fp"] != plain["fp"]
