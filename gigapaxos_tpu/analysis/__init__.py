@@ -1,10 +1,17 @@
-"""Project-native static analysis (``python -m gigapaxos_tpu.analysis``).
+"""Project-native correctness suite (``python -m gigapaxos_tpu.analysis``).
 
-Seven AST rules encoding this repo's concurrency and hot-path
-invariants — see ``decls.py`` for the registry, ADVICE.md for the
-postmortems behind each rule, and README "Static analysis" for usage
-(baselining, adding rules).  Pure stdlib ``ast``; never imports the
-code under analysis.
+Two layers.  Static: eleven AST rules encoding this repo's
+concurrency, hot-path, clock, wire-symmetry, event-loop and
+reset-scope invariants, with lock-set state flowing through a
+project-wide call graph (``callgraph.py``) so the lock rules see
+through helper delegation.  Runtime: a lockdep-style lock witness
+(``witness.py``, opt-in via ``PC.LOCK_WITNESS``) that records the
+acquisition DAG real executions exhibit and cross-checks it against
+the declared registry.  See ``decls.py`` for the registry, ADVICE.md
+for the postmortems behind each rule, and README "Static analysis"
+for usage (baselining, adding rules, reading a witness artifact).
+Pure stdlib ``ast``; the static layer never imports the code under
+analysis.
 """
 
 from gigapaxos_tpu.analysis.core import (BaselineError, Context,
@@ -12,11 +19,13 @@ from gigapaxos_tpu.analysis.core import (BaselineError, Context,
                                          build_context, load_baseline,
                                          split_baselined)
 from gigapaxos_tpu.analysis.decls import (Decls, HotPath,
-                                          ThreadedClass,
+                                          ThreadedClass, WireDecl,
                                           project_decls)
+from gigapaxos_tpu.analysis.witness import LockWitness, WitnessLock
 
 __all__ = [
     "BaselineError", "Context", "Decls", "Finding", "HotPath",
-    "ThreadedClass", "all_rules", "analyze", "build_context",
-    "load_baseline", "project_decls", "split_baselined",
+    "LockWitness", "ThreadedClass", "WireDecl", "WitnessLock",
+    "all_rules", "analyze", "build_context", "load_baseline",
+    "project_decls", "split_baselined",
 ]

@@ -1,13 +1,23 @@
-"""CLI: ``python -m gigapaxos_tpu.analysis [--baseline F] [--out F]``.
+"""CLI: ``python -m gigapaxos_tpu.analysis`` — both correctness layers.
 
-Exit 0 when every finding is covered by the baseline, 1 otherwise
-(new findings are listed; so are stale baseline entries, which don't
-fail the run but should be pruned).
+Layer 1 (static): the eleven AST rules over the tree, baselined by
+``ANALYSIS_BASELINE.json``; per-rule timings land in the ``--out``
+artifact.  Layer 2 (runtime): the lock witness arms every declared
+lock (``PC.LOCK_WITNESS``) and drives a real chaos drill
+(``mini_partition_heal``), then cross-checks the OBSERVED acquisition
+DAG against the declared registry and writes ``WITNESS_*.json``.
+
+Exit 0 only when the static sweep has no new findings AND the witness
+observed no undeclared edges and no cycles.  ``--static-only`` /
+``--witness-only`` select one layer (bin/check runs the static layer
+alone first — it fails in seconds — then a witness-armed smoke run).
 """
 
 from __future__ import annotations
 
 import argparse
+import json
+import os
 import sys
 import time
 from pathlib import Path
@@ -15,35 +25,12 @@ from pathlib import Path
 from gigapaxos_tpu.analysis import core, decls
 
 
-def main(argv=None) -> int:
-    ap = argparse.ArgumentParser(
-        prog="python -m gigapaxos_tpu.analysis",
-        description="project-native static analysis suite")
-    ap.add_argument("--root", default=None,
-                    help="repo root (default: auto-detect from the "
-                         "package location)")
-    ap.add_argument("--baseline", default=None,
-                    help="baseline JSON (default: "
-                         "<root>/ANALYSIS_BASELINE.json if present)")
-    ap.add_argument("--out", default=None,
-                    help="write the JSON artifact here "
-                         "(e.g. ANALYSIS_r01.json)")
-    ap.add_argument("--rules", default=None,
-                    help="comma-separated subset of rule ids")
-    ap.add_argument("--list-rules", action="store_true")
-    args = ap.parse_args(argv)
-
-    if args.list_rules:
-        for name in sorted(core.all_rules()):
-            print(name)
-        return 0
-
-    root = Path(args.root) if args.root else \
-        Path(__file__).resolve().parents[2]
+def _run_static(args, root: Path) -> int:
     t0 = time.monotonic()
     ctx = core.build_context(root, decls.project_decls())
     rules = args.rules.split(",") if args.rules else None
-    findings = core.analyze(ctx, rules)
+    timings: dict = {}
+    findings = core.analyze(ctx, rules, timings=timings)
 
     baseline = {}
     bl_path = Path(args.baseline) if args.baseline else \
@@ -58,12 +45,93 @@ def main(argv=None) -> int:
     print(f"({dt:.2f}s)")
 
     if args.out:
-        import json
-        payload = core.to_json(new, old, stale, nfiles)
+        payload = core.to_json(new, old, stale, nfiles,
+                               timings=timings)
         payload["elapsed_s"] = round(dt, 3)
         Path(args.out).write_text(json.dumps(payload, indent=2)
                                   + "\n")
     return 1 if new else 0
+
+
+def _run_witness(args, root: Path) -> int:
+    # the drill boots real (in-process) nodes; pin JAX to host CPU the
+    # same way conftest does so the drill runs anywhere
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+
+    from gigapaxos_tpu.analysis.witness import LockWitness
+    from gigapaxos_tpu.paxos.paxosconfig import PC
+    from gigapaxos_tpu.utils.config import Config
+
+    out = args.witness_out or Config.get(PC.WITNESS_OUT) \
+        or str(root / "WITNESS_r01.json")
+    print(f"== lock witness: drill '{args.drill}' ==")
+    LockWitness.reset()
+    Config.set(PC.LOCK_WITNESS, True)
+    t0 = time.monotonic()
+    try:
+        from gigapaxos_tpu.chaos.scenarios import run_scenario
+        row = run_scenario(args.drill, seed=args.seed)
+        rep = LockWitness.report()
+    finally:
+        Config.unset(PC.LOCK_WITNESS)
+        LockWitness.reset()
+    rep["drill"] = {"scenario": args.drill, "seed": args.seed,
+                    "scenario_ok": bool(row.get("ok")),
+                    "elapsed_s": round(time.monotonic() - t0, 3)}
+    print(LockWitness.render(rep))
+    Path(out).write_text(json.dumps(rep, indent=2) + "\n")
+    print(f"({rep['drill']['elapsed_s']:.2f}s; artifact: {out})")
+    return 0 if rep["ok"] else 1
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m gigapaxos_tpu.analysis",
+        description="two-layer correctness suite: static AST rules "
+                    "+ runtime lock witness")
+    ap.add_argument("--root", default=None,
+                    help="repo root (default: auto-detect from the "
+                         "package location)")
+    ap.add_argument("--baseline", default=None,
+                    help="baseline JSON (default: "
+                         "<root>/ANALYSIS_BASELINE.json if present)")
+    ap.add_argument("--out", default=None,
+                    help="write the static JSON artifact here "
+                         "(e.g. ANALYSIS_r01.json)")
+    ap.add_argument("--rules", default=None,
+                    help="comma-separated subset of rule ids")
+    ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("--static-only", action="store_true",
+                    help="skip the runtime witness drill")
+    ap.add_argument("--witness-only", action="store_true",
+                    help="skip the static sweep")
+    ap.add_argument("--witness-out", default=None,
+                    help="witness artifact path (default: "
+                         "PC.WITNESS_OUT or <root>/WITNESS_r01.json)")
+    ap.add_argument("--drill", default="mini_partition_heal",
+                    help="chaos scenario the witness drives")
+    ap.add_argument("--seed", type=int, default=1)
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for name in sorted(core.all_rules()):
+            print(name)
+        return 0
+
+    root = Path(args.root) if args.root else \
+        Path(__file__).resolve().parents[2]
+    rc = 0
+    if not args.witness_only:
+        rc |= _run_static(args, root)
+    if not args.static_only:
+        print()
+        rc |= _run_witness(args, root)
+    return rc
 
 
 if __name__ == "__main__":

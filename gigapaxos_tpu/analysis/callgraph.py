@@ -1,0 +1,244 @@
+"""Project-wide call graph shared by the interprocedural rules.
+
+PR 7's rules were lexical — one function body at a time — which is
+exactly the blind spot the incidents came through (the WAL
+closed-handle race and the lane-counter races both crossed a helper
+boundary).  This module builds one AST-level call graph per analysis
+run and the rules that need flow (lock-order, race, clockpurity,
+loopblock) share it via ``Context.callgraph()``.
+
+Resolution is deliberately cheap and conservative — no type checker,
+just the idioms this tree actually uses:
+
+* ``self.meth()`` / ``cls.meth()`` (and the first positional arg of a
+  function used as a receiver) resolve into the enclosing class,
+  walking base classes declared in-tree;
+* ``ClassName.meth()`` resolves for any class defined in the tree
+  (the singleton style: ``DelayProfiler.update_total(...)``);
+* ``self.attr.meth()`` resolves when some method assigns
+  ``self.attr = ClassName(...)`` (constructor-typed attributes:
+  ``self.transport = Transport(...)``);
+* ``x = self.attr`` / ``x = ClassName(...)`` aliases are tracked per
+  function body;
+* bare ``name()`` resolves to a module-level function in the same
+  file.
+
+Unresolvable calls (dynamic dispatch, dict-of-callables, stdlib) are
+simply absent edges: the graph under-approximates, so reachability
+rules may miss exotic paths but never invent them.  Nested ``def``
+bodies contribute their calls to the enclosing function — a closure
+created on a path is treated as running on that path, which is the
+conservative direction for purity/blocking rules.
+
+Function ids: methods are ``"Class.method"`` (class names are unique
+in this tree — the graph keeps the first definition and ignores
+re-definitions); module-level functions are ``"<rel-path>:name"`` so
+same-named helpers in different files stay distinct.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from gigapaxos_tpu.analysis.core import (FUNC_NODES, SourceFile,
+                                         first_arg_name)
+
+
+@dataclass
+class FuncInfo:
+    """One top-level function or method in the graph."""
+
+    fid: str                 # graph id ("Class.method" / "rel:name")
+    qualname: str            # finding qualname ("Class.method" / "name")
+    sf: SourceFile
+    cls: Optional[str]       # enclosing class name, if any
+    func: ast.AST            # FunctionDef / AsyncFunctionDef
+
+    @property
+    def is_async(self) -> bool:
+        return isinstance(self.func, ast.AsyncFunctionDef)
+
+
+class CallGraph:
+    def __init__(self) -> None:
+        self.funcs: Dict[str, FuncInfo] = {}
+        # class name -> tuple of base-class names (in-tree names only)
+        self.bases: Dict[str, Tuple[str, ...]] = {}
+        # (class, attr) -> class name of `self.attr = ClassName(...)`
+        self.attr_types: Dict[Tuple[str, str], str] = {}
+        # (rel, name) -> fid for module-level functions
+        self.module_funcs: Dict[Tuple[str, str], str] = {}
+        # caller fid -> [(callee fid, Call node)]
+        self.edges: Dict[str, List[Tuple[str, ast.Call]]] = {}
+        # callee fid -> {caller fid}
+        self.callers: Dict[str, Set[str]] = {}
+
+    # -- lookup ---------------------------------------------------------
+
+    def method_id(self, cls: Optional[str], name: str) -> Optional[str]:
+        """Resolve ``cls.name`` walking declared in-tree bases (BFS)."""
+        if cls is None:
+            return None
+        queue, seen = [cls], set()
+        while queue:
+            c = queue.pop(0)
+            if c in seen:
+                continue
+            seen.add(c)
+            fid = f"{c}.{name}"
+            if fid in self.funcs:
+                return fid
+            queue.extend(self.bases.get(c, ()))
+        return None
+
+    def callees(self, fid: str) -> List[Tuple[str, ast.Call]]:
+        return self.edges.get(fid, [])
+
+    def reach(self, roots: Sequence[str],
+              max_depth: int = 64) -> Dict[str, Tuple[str, ...]]:
+        """BFS reachability: fid -> first-found call chain from a root
+        (inclusive).  ``max_depth`` bounds the chain; the visited set
+        cuts cycles."""
+        paths: Dict[str, Tuple[str, ...]] = {}
+        frontier: List[Tuple[str, ...]] = [
+            (r,) for r in roots if r in self.funcs]
+        for p in frontier:
+            paths.setdefault(p[0], p)
+        while frontier:
+            nxt: List[Tuple[str, ...]] = []
+            for path in frontier:
+                if len(path) >= max_depth:
+                    continue
+                for callee, _node in self.callees(path[-1]):
+                    if callee in paths:
+                        continue
+                    paths[callee] = path + (callee,)
+                    nxt.append(paths[callee])
+            frontier = nxt
+        return paths
+
+
+# ---------------------------------------------------------------------------
+# construction
+
+
+def _class_attr_types(cls: ast.ClassDef,
+                      known: Set[str]) -> Dict[str, str]:
+    """``self.attr = ClassName(...)`` anywhere in the class body."""
+    out: Dict[str, str] = {}
+    for fn in cls.body:
+        if not isinstance(fn, FUNC_NODES):
+            continue
+        recv = first_arg_name(fn) or "self"
+        for node in ast.walk(fn):
+            if (isinstance(node, ast.Assign)
+                    and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Attribute)
+                    and isinstance(node.targets[0].value, ast.Name)
+                    and node.targets[0].value.id in (recv, "self")
+                    and isinstance(node.value, ast.Call)
+                    and isinstance(node.value.func, ast.Name)
+                    and node.value.func.id in known):
+                out[node.targets[0].attr] = node.value.func.id
+    return out
+
+
+def _local_aliases(fi: FuncInfo, cg: CallGraph,
+                   known: Set[str]) -> Dict[str, str]:
+    """``x = ClassName(...)`` / ``x = self.attr`` -> {x: ClassName}."""
+    recv = first_arg_name(fi.func) or "self"
+    out: Dict[str, str] = {}
+    for node in ast.walk(fi.func):
+        if not (isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)):
+            continue
+        tgt = node.targets[0].id
+        v = node.value
+        if (isinstance(v, ast.Call) and isinstance(v.func, ast.Name)
+                and v.func.id in known):
+            out[tgt] = v.func.id
+        elif (fi.cls is not None and isinstance(v, ast.Attribute)
+                and isinstance(v.value, ast.Name)
+                and v.value.id in (recv, "self")):
+            t = cg.attr_types.get((fi.cls, v.attr))
+            if t is not None:
+                out[tgt] = t
+    return out
+
+
+def resolve_call(cg: CallGraph, fi: FuncInfo, call: ast.Call,
+                 aliases: Optional[Dict[str, str]] = None) \
+        -> Optional[str]:
+    """Best-effort resolution of one Call node to a graph fid."""
+    if aliases is None:
+        aliases = {}
+    f = call.func
+    recv = first_arg_name(fi.func) or "self"
+    if isinstance(f, ast.Name):
+        fid = cg.module_funcs.get((fi.sf.rel, f.id))
+        if fid is not None:
+            return fid
+        if f.id in cg.bases:          # constructor call
+            return cg.method_id(f.id, "__init__")
+        return None
+    if not (isinstance(f, ast.Attribute)):
+        return None
+    v = f.value
+    if isinstance(v, ast.Name):
+        if v.id in (recv, "self", "cls"):
+            return cg.method_id(fi.cls, f.attr)
+        if v.id in cg.bases:          # ClassName.meth(...)
+            return cg.method_id(v.id, f.attr)
+        if v.id in aliases:
+            return cg.method_id(aliases[v.id], f.attr)
+        return None
+    if (isinstance(v, ast.Attribute) and isinstance(v.value, ast.Name)
+            and v.value.id in (recv, "self") and fi.cls is not None):
+        t = cg.attr_types.get((fi.cls, v.attr))
+        if t is not None:
+            return cg.method_id(t, f.attr)
+    return None
+
+
+def build(files: Sequence[SourceFile]) -> CallGraph:
+    cg = CallGraph()
+    classes: List[Tuple[SourceFile, ast.ClassDef]] = []
+    for sf in files:
+        for node in sf.tree.body:
+            if isinstance(node, ast.ClassDef):
+                if node.name not in cg.bases:
+                    classes.append((sf, node))
+                    cg.bases[node.name] = tuple(
+                        b.id for b in node.bases
+                        if isinstance(b, ast.Name))
+                for fn in node.body:
+                    if isinstance(fn, FUNC_NODES):
+                        fid = f"{node.name}.{fn.name}"
+                        if fid not in cg.funcs:
+                            cg.funcs[fid] = FuncInfo(
+                                fid, fid, sf, node.name, fn)
+            elif isinstance(node, FUNC_NODES):
+                fid = f"{sf.rel}:{node.name}"
+                cg.funcs[fid] = FuncInfo(fid, node.name, sf, None, node)
+                cg.module_funcs[(sf.rel, node.name)] = fid
+    # constructor-typed attributes need the full class index first
+    known = set(cg.bases)
+    for sf, cls in classes:
+        for attr, t in _class_attr_types(cls, known).items():
+            cg.attr_types.setdefault((cls.name, attr), t)
+    # edges
+    for fid, fi in cg.funcs.items():
+        aliases = _local_aliases(fi, cg, known)
+        out: List[Tuple[str, ast.Call]] = []
+        for node in ast.walk(fi.func):
+            if isinstance(node, ast.Call):
+                callee = resolve_call(cg, fi, node, aliases)
+                if callee is not None and callee != fid:
+                    out.append((callee, node))
+                    cg.callers.setdefault(callee, set()).add(fid)
+        if out:
+            cg.edges[fid] = out
+    return cg

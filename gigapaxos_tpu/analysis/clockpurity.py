@@ -1,0 +1,97 @@
+"""Rule: engine-clock purity on digest-affecting wave paths (R8).
+
+The PR 8 incident class: the blackbox replay gate is bit-for-bit only
+because every wave-visible timestamp goes through ``PaxosNode._now()``
+(the wave-pinned engine clock).  ONE new ``time.time()`` read on a
+path reachable from ``_process``/``_tick`` silently forks replay from
+capture — it type-checks, every test passes, and the divergence only
+shows when someone replays a black box from a real incident.
+
+So the rule is transitive: walk the call graph from the declared
+``decls.wave_roots``, and flag any wall-clock read
+(``time.time/monotonic/time_ns/monotonic_ns/perf_counter*``) in any
+reachable function.  The declared ``decls.engine_clock`` accessor is
+skipped (it IS the sanctioned fallback when no wave pin is set).
+Measurement-only sites — stamps that feed metrics or artifacts, never
+a frame or digest — are declared exempt in ``decls.clock_exempt``
+with a mandatory why; an exemption with an EMPTY why does not exempt.
+
+Findings are anchored at the clock-read site (fingerprints survive
+caller edits); the message carries the root->site call chain so the
+reader sees why the site is wave-reachable.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Tuple
+
+from gigapaxos_tpu.analysis.core import Context, Finding
+
+RULE = "clockpurity"
+
+WALL_CLOCKS = frozenset({
+    "time", "monotonic", "time_ns", "monotonic_ns",
+    "perf_counter", "perf_counter_ns",
+})
+
+
+def _is_exempt(exempt: Dict[str, str], qualname: str,
+               snippet: str) -> bool:
+    cls = qualname.split(".", 1)[0] if "." in qualname else None
+    for key, why in exempt.items():
+        if not (why or "").strip():
+            continue  # empty why = not an exemption (teeth on decls)
+        if "::" in key:
+            qn, frag = key.split("::", 1)
+            if qn == qualname and frag in snippet:
+                return True
+        elif key.endswith(".*"):
+            if cls is not None and key[:-2] == cls:
+                return True
+        elif key == qualname:
+            return True
+    return False
+
+
+def check(ctx: Context) -> List[Finding]:
+    decls = ctx.decls
+    roots: Tuple[str, ...] = getattr(decls, "wave_roots", ()) or ()
+    if not roots:
+        return []
+    exempt: Dict[str, str] = getattr(decls, "clock_exempt", {}) or {}
+    engine_clock: str = getattr(decls, "engine_clock", "") or ""
+    cg = ctx.callgraph()
+    paths = cg.reach(roots)
+    findings: List[Finding] = []
+    seen = set()
+    for fid in sorted(paths):
+        if fid == engine_clock:
+            continue
+        fi = cg.funcs[fid]
+        for node in ast.walk(fi.func):
+            # clock reads inside a nested def still count: a closure
+            # minted on a wave path is assumed to run on one
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.value.id == "time"
+                    and node.func.attr in WALL_CLOCKS):
+                continue
+            snippet = fi.sf.snippet(node)
+            if _is_exempt(exempt, fi.qualname, snippet):
+                continue
+            key = (fi.qualname, snippet)
+            if key in seen:
+                continue
+            seen.add(key)
+            chain = " -> ".join(paths[fid])
+            findings.append(Finding(
+                RULE, fi.sf.rel, getattr(node, "lineno", 0),
+                fi.qualname,
+                f"wall-clock read time.{node.func.attr}() on a "
+                f"digest-affecting wave path ({chain}) — use "
+                f"{engine_clock or 'the engine clock'}() or declare "
+                f"the site measurement-exempt in decls.clock_exempt",
+                snippet))
+    return findings

@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import ast
 import json
+import time
 from dataclasses import dataclass, field
 from pathlib import Path
 from typing import Callable, Dict, List, Optional, Sequence
@@ -76,10 +77,20 @@ class Context:
     doc_text: str = ""           # README + MIGRATING (knob docs)
     conftest_src: str = ""       # tests/conftest.py (knob resets)
     usage_files: List[SourceFile] = field(default_factory=list)
+    _callgraph: "object" = field(default=None, repr=False)
 
     def all_files(self) -> List[SourceFile]:
         """Files whose ASTs count as knob *usage* (tree + tests)."""
         return self.files + self.usage_files
+
+    def callgraph(self):
+        """The project call graph, built once and shared across rules
+        (the interprocedural rules all read it; rebuilding per rule
+        would blow the sweep's time budget)."""
+        if self._callgraph is None:
+            from gigapaxos_tpu.analysis import callgraph
+            self._callgraph = callgraph.build(self.files)
+        return self._callgraph
 
 
 class ScopedVisitor(ast.NodeVisitor):
@@ -279,8 +290,9 @@ def split_baselined(findings: Sequence[Finding],
 
 def all_rules() -> Dict[str, Callable[[Context], List[Finding]]]:
     # local import: rule modules import core
-    from gigapaxos_tpu.analysis import (hotpath, initflow, jitpurity,
-                                        knobs, locks)
+    from gigapaxos_tpu.analysis import (clockpurity, hotpath, initflow,
+                                        jitpurity, knobs, locks,
+                                        loopblock, resetscope, wiresym)
     return {
         "lock-order": locks.check_lock_order,
         "race": locks.check_races,
@@ -289,17 +301,28 @@ def all_rules() -> Dict[str, Callable[[Context], List[Finding]]]:
         "hot-path": hotpath.check,
         "knobs": knobs.check,
         "jit-purity": jitpurity.check,
+        "clockpurity": clockpurity.check,
+        "wiresym": wiresym.check,
+        "loopblock": loopblock.check,
+        "resetscope": resetscope.check,
     }
 
 
 def analyze(ctx: Context,
-            rules: Optional[Sequence[str]] = None) -> List[Finding]:
+            rules: Optional[Sequence[str]] = None,
+            timings: Optional[Dict[str, float]] = None) -> List[Finding]:
+    """Run the rule table; per-rule wall seconds land in ``timings``
+    when a dict is passed (the artifact records them so a slow rule is
+    attributable, not a mystery in the sweep total)."""
     table = all_rules()
     if rules:
         table = {k: v for k, v in table.items() if k in rules}
     findings: List[Finding] = []
-    for _name, fn in table.items():
+    for name, fn in table.items():
+        t0 = time.perf_counter()
         findings.extend(fn(ctx))
+        if timings is not None:
+            timings[name] = round(time.perf_counter() - t0, 4)
     findings.sort(key=lambda f: (f.rule, f.path, f.line))
     return findings
 
@@ -324,15 +347,17 @@ def report(findings: Sequence[Finding], baselined: Sequence[Finding],
 
 
 def to_json(findings: Sequence[Finding], baselined: Sequence[Finding],
-            stale: Sequence[str], nfiles: int) -> dict:
+            stale: Sequence[str], nfiles: int,
+            timings: Optional[Dict[str, float]] = None) -> dict:
     counts: Dict[str, int] = {}
     for f in list(findings) + list(baselined):
         counts[f.rule] = counts.get(f.rule, 0) + 1
     return {
-        "schema": "gigapaxos_tpu.analysis/v1",
+        "schema": "gigapaxos_tpu.analysis/v2",
         "files_scanned": nfiles,
         "rules": sorted(all_rules()),
         "per_rule": counts,
+        "rule_timings_s": dict(sorted((timings or {}).items())),
         "new": len(findings),
         "baselined": len(baselined),
         "stale_baseline": list(stale),

@@ -58,6 +58,30 @@ class HotPath:
 
 
 @dataclass(frozen=True)
+class WireDecl:
+    """The wire-plane symmetry contract ``wiresym`` checks.
+
+    All names refer to literals inside ``packets_rel``: the frame-type
+    enum, the type->codec dispatch dict, the FRAG column packer/
+    unpacker dicts, and the hello negotiation table (member name ->
+    minimum peer wire version).  ``special_types`` are members with
+    container/handshake semantics that deliberately have no entry in
+    the codec dispatch; ``version_gated`` members may only be sent to
+    a peer after its hello announced a sufficient version, so they
+    must appear in the gate table.
+    """
+
+    packets_rel: str = "gigapaxos_tpu/paxos/packets.py"
+    enum_name: str = "PacketType"
+    decoders_name: str = "_DECODERS"
+    packers_name: str = "_FRAG_PACKERS"
+    unpackers_name: str = "_FRAG_UNPACKERS"
+    gate_table: str = "WIRE_GATED"
+    special_types: FrozenSet[str] = frozenset({"FRAG", "WIRE_HELLO"})
+    version_gated: FrozenSet[str] = frozenset({"FRAG"})
+
+
+@dataclass(frozen=True)
 class Decls:
     threaded: Dict[str, ThreadedClass] = field(default_factory=dict)
     hot_paths: Dict[str, HotPath] = field(default_factory=dict)
@@ -80,6 +104,28 @@ class Decls:
     knob_families: Dict[str, Optional[str]] = field(default_factory=dict)
     # config class name holding the knob enum ("PC")
     knob_class: str = "PC"
+    # -- interprocedural rules (analysis v2) ---------------------------
+    # digest-affecting wave entry points: everything reachable from
+    # these must read the engine clock, never the wall clock
+    wave_roots: Tuple[str, ...] = ()
+    # the one declared engine-clock accessor ("PaxosNode._now") —
+    # itself allowed to read time.time() (it IS the pin fallback)
+    engine_clock: str = ""
+    # clockpurity exemptions: "Class.*" (whole class), "qualname"
+    # (whole function) or "qualname::snippet-fragment" (one site) ->
+    # non-empty why.  An empty why does NOT exempt — the rule treats
+    # it as undeclared and fires.
+    clock_exempt: Dict[str, str] = field(default_factory=dict)
+    # loopblock exemptions, same key forms and same empty-why teeth
+    loopblock_exempt: Dict[str, str] = field(default_factory=dict)
+    # resetscope: rel-path suffixes of the scenario/harness files the
+    # rule patrols, the mutator -> restorer call pairs it enforces,
+    # and qualname exemptions (why required)
+    reset_scope_files: Tuple[str, ...] = ()
+    reset_pairs: Dict[str, Tuple[str, ...]] = field(default_factory=dict)
+    reset_exempt: Dict[str, str] = field(default_factory=dict)
+    # wire-plane symmetry contract (None disables wiresym)
+    wire: Optional[WireDecl] = None
 
 
 def project_decls() -> Decls:
@@ -227,6 +273,12 @@ def project_decls() -> Decls:
             "DelayProfiler._lock", "RequestInstrumenter._lock",
             "ChaosPlane._lock", "Config._lock",
             "BlackboxRecorder._lock", "BlackboxRecorder._live_lock",
+            # added by the first lock-witness drill (WITNESS_r01): the
+            # engine lanes nest WAL-segment and sqlite sections inside
+            # the lane lock on every durable wave — both sections are
+            # self-contained (no lock acquired inside), so they are
+            # leaves the registry had simply never declared
+            "PaxosLogger._wal_locks", "PaxosLogger._db_lock",
         }),
         indexed_locks={
             "PaxosNode._engine_locks": ("_locks_for",),
@@ -246,5 +298,101 @@ def project_decls() -> Decls:
             # wire-plane knobs (PR 13): read once into the Transport at
             # node boot, torn down with the node — same contract
             "WIRE_": None,
+            # lock-witness knobs mirror into the LockWitness singleton
+            # (wrapped locks + the observed acquisition graph): a test
+            # that arms it must not leak edges into the next test
+            "LOCK_WITNESS": "LockWitness.reset",
+            "WITNESS_": "LockWitness.reset",
         },
+        # -- clockpurity ------------------------------------------------
+        # wave entry points whose transitive closure feeds the blackbox
+        # digests: _process (decode->handle->emit) and the tick path
+        # (redrive/failover emissions ride the same digest stream)
+        wave_roots=("PaxosNode._process", "PaxosNode._tick",
+                    "PaxosNode._tick_inner"),
+        engine_clock="PaxosNode._now",
+        clock_exempt={
+            # measurement-only stamps: they ride the artifact/metrics
+            # plane, never a frame or a digest input
+            "PaxosNode._process::_batch_t0":
+                "wall anchor for the client-retry sleep budget; "
+                "compared against client deadlines, not digested",
+            "PaxosNode._process::monotonic":
+                "emit-stage queue-delay profiler stamp (metrics only)",
+            "PaxosNode._process_inner::time_ns":
+                "RTT sample fed to Transport.note_rtt (metrics only)",
+            "PaxosNode._process_inner::monotonic":
+                "per-wave handler-latency profiler span (metrics only)",
+            "PaxosNode._execute_row::_batch_t0":
+                "app-retry sleep budget: wall elapsed vs the batch's "
+                "wall anchor gates a retry SLEEP, never a frame field",
+            "PaxosNode._execute_row::waiter[1]":
+                "client-waiter end-to-end latency sample "
+                "(DelayProfiler plane)",
+            "PaxosNode._elect_rows_led_by::monotonic":
+                "election-scan profiler span (metrics only)",
+            "PaxosNode._start_elections_batch::monotonic":
+                "failover-batch profiler span (metrics only)",
+            "PaxosNode._install_simple_rows::monotonic":
+                "mass-install profiler span (metrics only)",
+            "PaxosLogger.log_raw_inline::monotonic":
+                "WAL-append latency profiler span (metrics only)",
+            "_Rate.*":
+                "DelayProfiler's internal rate window — the "
+                "measurement plane's own clock",
+            "Transport.*":
+                "transport timing is pacing/metrics (RTT notes, paced "
+                "sends, reconnect backoff); frames it moves are "
+                "byte-identical regardless, so digests never see it",
+            "DelayProfiler.*":
+                "the profiler IS the measurement plane",
+            "RequestInstrumenter.*":
+                "per-request tracing stamps (observability plane)",
+            "BlackboxRecorder.*":
+                "capture-ring wall stamps annotate records for humans; "
+                "replay digests come from note_frames' pinned ts",
+            "ChaosPlane.*":
+                "fault-injection delay arithmetic; chaos runs are "
+                "seed-deterministic via their own rng, and the engine "
+                "digests are taken on the frames it delivers",
+        },
+        # -- loopblock --------------------------------------------------
+        loopblock_exempt={},
+        # -- resetscope -------------------------------------------------
+        reset_scope_files=("gigapaxos_tpu/chaos/scenarios.py",
+                           "gigapaxos_tpu/testing/harness.py"),
+        reset_pairs={
+            # Config.set counts as its own restorer: a finally that
+            # re-installs the prior value is the canonical pattern
+            "Config.set": ("Config.clear", "Config.unset",
+                           "Config.set"),
+            "ChaosPlane.configure": ("ChaosPlane.reset",),
+            "ChaosPlane.set_link": ("ChaosPlane.reset",
+                                    "ChaosPlane.heal"),
+            "ChaosPlane.partition": ("ChaosPlane.reset",
+                                     "ChaosPlane.heal"),
+        },
+        reset_exempt={
+            "PaxosEmulation.__init__":
+                "every boot sets its knobs explicitly and tests "
+                "restore via the autouse Config.clear fixture; the "
+                "emulation object has no teardown scope of its own",
+            "_sc_shard_storm":
+                "restored by run_scenario's finally (prior_shards "
+                "re-install); the dict-dispatch call spec['fn'](ctx) "
+                "is invisible to the dominator check",
+            "_sc_partition_heal":
+                "chaos rules restored by run_scenario's finally "
+                "(ChaosPlane.reset) across the dict dispatch",
+            "_sc_rolling_restart":
+                "chaos rules restored by run_scenario's finally "
+                "(ChaosPlane.reset) across the dict dispatch",
+            "_sc_zipf_hot":
+                "chaos rules restored by run_scenario's finally "
+                "(ChaosPlane.reset) across the dict dispatch",
+            "_sc_mini_partition_heal":
+                "chaos rules restored by run_scenario's finally "
+                "(ChaosPlane.reset) across the dict dispatch",
+        },
+        wire=WireDecl(),
     )

@@ -1,14 +1,18 @@
 """Rules: lock-order (R1) and shared-state race lint (R2).
 
-R1 builds the lexical lock-acquisition graph: every ``with <lock>``
-nested inside another ``with <lock>`` is an observed outer->inner
-edge.  Edges that contradict ``decls.lock_order``, edges out of a
-declared leaf lock, cycles in the observed graph, re-entrant
-acquisition of non-reentrant locks, and *accumulating* acquisition of
-an indexed lock list (ExitStack) outside the declared ordered helper
-are all findings.  Known limitation (documented in README): edges are
-lexical per function — an edge through a call chain is invisible, so
-the declared order carries the interprocedural contract.
+R1 builds the lock-acquisition graph: every ``with <lock>`` nested
+inside another ``with <lock>`` is an observed outer->inner edge.
+Edges that contradict ``decls.lock_order``, edges out of a declared
+leaf lock, cycles in the observed graph, re-entrant acquisition of
+non-reentrant locks, and *accumulating* acquisition of an indexed
+lock list (ExitStack) outside the declared ordered helper are all
+findings.  Since analysis v2 the lock-sets FLOW through the project
+call graph: every call made while holding a lock propagates the held
+set into the callee (union over call sites, bounded fixpoint rounds
+cut cycles), so a helper that acquires a lock is checked against
+every lock any caller path already holds.  Interprocedural findings
+are anchored at the *acquisition site inside the callee* — their
+fingerprints survive unrelated edits to callers.
 
 R2 flags mutations of declared-guarded attributes outside ``with
 <their lock>``: ``self.n += 1``, ``self.d[k] = v``, rebinding, del,
@@ -16,6 +20,11 @@ mutator method calls (``.append``/``.pop``/...), and
 ``heapq.heappush(self.x, ...)``.  ``__init__``/``__new__`` are exempt
 (no second thread exists yet); nested ``def`` bodies are checked with
 an empty held-set (a closure may run after the lock is released).
+Analysis v2 adds interprocedural *exoneration*: a private helper
+(``_name``, non-dunder) that mutates a guarded attr is clean iff
+EVERY in-tree caller path provably holds the lock (intersection over
+call sites of lexically-held ∪ caller's guaranteed set; a helper
+with no in-tree callers gets the empty set — pessimistic on purpose).
 """
 
 from __future__ import annotations
@@ -51,6 +60,23 @@ def _attr_of(expr: ast.AST, recv: Set[str]) -> Optional[str]:
             and expr.value.id in recv):
         return expr.attr
     return None
+
+
+def _header_exprs(st: ast.stmt) -> List[ast.AST]:
+    """The parts of a statement that execute under the CURRENT held
+    set.  Compound bodies are excluded — the walkers recurse into them
+    with their own (possibly extended) held-set — and nested ``def``s
+    are excluded entirely (a closure runs later, lock-free)."""
+    if isinstance(st, (ast.With, ast.AsyncWith)):
+        return [i.context_expr for i in st.items]
+    if isinstance(st, (ast.If, ast.While)):
+        return [st.test]
+    if isinstance(st, (ast.For, ast.AsyncFor)):
+        return [st.iter]
+    if isinstance(st, (ast.Try, ast.ClassDef)) \
+            or isinstance(st, FUNC_NODES):
+        return []
+    return [st]
 
 
 class _LockRef:
@@ -132,7 +158,9 @@ class _OrderWalker:
     """Per-function lexical walk collecting acquisitions and edges."""
 
     def __init__(self, sf: SourceFile, class_name: Optional[str],
-                 func, qualname: str, decls, edges, findings):
+                 func, qualname: str, decls, edges, findings,
+                 fid: Optional[str] = None, call_sites=None,
+                 acquisitions=None):
         self.sf = sf
         self.class_name = class_name
         self.qualname = qualname
@@ -141,6 +169,11 @@ class _OrderWalker:
         self.findings = findings
         self.recv = _receivers(class_name or "", func)
         self.local_locks: Dict[str, _LockRef] = {}
+        # interprocedural capture: graph id of this function, calls
+        # made with locks held, and every lock acquisition in it
+        self.fid = fid
+        self.call_sites = call_sites      # [(fid, Call, frozenset lids)]
+        self.acquisitions = acquisitions  # [(fid, ref, sf, node, qn)]
 
     def _finding(self, node: ast.AST, msg: str) -> None:
         self.findings.append(Finding(
@@ -149,6 +182,9 @@ class _OrderWalker:
 
     def _acquire(self, ref: _LockRef, node: ast.AST,
                  held: List[_LockRef]) -> None:
+        if self.acquisitions is not None and self.fid is not None:
+            self.acquisitions.append(
+                (self.fid, ref, self.sf, node, self.qualname))
         for h in held:
             if h.lid == ref.lid:
                 same_const_index = (
@@ -171,12 +207,26 @@ class _OrderWalker:
                 self.edges.append((h.lid, ref.lid, self.sf, node,
                                    self.qualname))
 
+    def _record_calls(self, st: ast.stmt,
+                      held: List[_LockRef]) -> None:
+        """Record calls in this statement's *header* (bodies recurse
+        through walk with their own held-set) with the current held
+        lock ids — the raw material the interprocedural flow reads."""
+        if self.call_sites is None or self.fid is None:
+            return
+        for e in _header_exprs(st):
+            hl = frozenset(h.lid for h in held)
+            for n in ast.walk(e):
+                if isinstance(n, ast.Call):
+                    self.call_sites.append((self.fid, n, hl))
+
     def walk(self, stmts: List[ast.stmt],
              held: List[_LockRef]) -> None:
         # `held` grows within this block when an ExitStack For
         # accumulates locks that stay held for the rest of the block
         held = list(held)
         for st in stmts:
+            self._record_calls(st, held)
             if isinstance(st, (ast.With, ast.AsyncWith)):
                 acquired = []
                 for item in st.items:
@@ -271,6 +321,78 @@ class _OrderWalker:
         return accumulated
 
 
+# ---------------------------------------------------------------------------
+# interprocedural lock-set flow
+
+_FLOW_ROUNDS = 12  # depth bound: cycles in the call graph are cut here
+
+
+def _resolve_sites(ctx: Context, call_sites):
+    """[(caller fid, Call, held)] -> [(callee fid, caller fid, held)],
+    dropping unresolvable calls.  Alias maps are cached per caller."""
+    from gigapaxos_tpu.analysis import callgraph as cgmod
+    cg = ctx.callgraph()
+    known = set(cg.bases)
+    alias_cache: Dict[str, Dict[str, str]] = {}
+    out = []
+    for fid, call, held in call_sites:
+        fi = cg.funcs.get(fid)
+        if fi is None:
+            continue
+        aliases = alias_cache.get(fid)
+        if aliases is None:
+            aliases = cgmod._local_aliases(fi, cg, known)
+            alias_cache[fid] = aliases
+        callee = cgmod.resolve_call(cg, fi, call, aliases)
+        if callee is not None and callee != fid:
+            out.append((callee, fid, held))
+    return out
+
+
+def _flow_entry_held(resolved) -> Dict[str, frozenset]:
+    """Union semantics: a lock held on ANY caller path counts as held
+    at the callee's entry (right for ordering hazards — one bad path
+    is a deadlock seed)."""
+    entry: Dict[str, frozenset] = {}
+    for _ in range(_FLOW_ROUNDS):
+        changed = False
+        for callee, caller, held in resolved:
+            u = held | entry.get(caller, frozenset())
+            cur = entry.get(callee, frozenset())
+            if not u <= cur:
+                entry[callee] = cur | u
+                changed = True
+        if not changed:
+            break
+    return entry
+
+
+def _flow_guaranteed(resolved) -> Dict[str, frozenset]:
+    """Intersection semantics: a lock is guaranteed at a callee's
+    entry only when EVERY in-tree call site holds it (right for race
+    exoneration — one unlocked path is a race).  Starts empty and
+    grows monotonically, so the bounded iteration under-approximates:
+    it can only fail to exonerate, never wrongly exonerate."""
+    sites: Dict[str, List] = {}
+    for callee, caller, held in resolved:
+        sites.setdefault(callee, []).append((caller, held))
+    guaranteed: Dict[str, frozenset] = {}
+    for _ in range(_FLOW_ROUNDS):
+        changed = False
+        for callee, ss in sites.items():
+            inter = None
+            for caller, held in ss:
+                u = held | guaranteed.get(caller, frozenset())
+                inter = u if inter is None else (inter & u)
+            inter = inter or frozenset()
+            if inter != guaranteed.get(callee, frozenset()):
+                guaranteed[callee] = inter
+                changed = True
+        if not changed:
+            break
+    return guaranteed
+
+
 def _check_helper_sorts(ctx: Context, findings: List[Finding]) -> None:
     """A declared ordered helper must actually sort."""
     wanted: Dict[Tuple[str, str], str] = {}
@@ -307,22 +429,37 @@ def _check_helper_sorts(ctx: Context, findings: List[Finding]) -> None:
 def check_lock_order(ctx: Context) -> List[Finding]:
     findings: List[Finding] = []
     edges: List[tuple] = []
+    call_sites: List[tuple] = []
+    acquisitions: List[tuple] = []
     for sf in ctx.files:
         for node in ast.walk(sf.tree):
             if not isinstance(node, ast.ClassDef):
                 continue
             for fn in node.body:
                 if isinstance(fn, FUNC_NODES):
-                    w = _OrderWalker(sf, node.name, fn,
-                                     f"{node.name}.{fn.name}",
-                                     ctx.decls, edges, findings)
+                    fid = f"{node.name}.{fn.name}"
+                    w = _OrderWalker(sf, node.name, fn, fid,
+                                     ctx.decls, edges, findings,
+                                     fid=fid, call_sites=call_sites,
+                                     acquisitions=acquisitions)
                     w.walk(fn.body, [])
         # module-level functions (lock use via ClassName.attr)
         for fn in sf.tree.body:
             if isinstance(fn, FUNC_NODES):
                 w = _OrderWalker(sf, None, fn, fn.name, ctx.decls,
-                                 edges, findings)
+                                 edges, findings,
+                                 fid=f"{sf.rel}:{fn.name}",
+                                 call_sites=call_sites,
+                                 acquisitions=acquisitions)
                 w.walk(fn.body, [])
+    # interprocedural edges: locks held at a call site flow into the
+    # callee, so its acquisitions pair against them.  Anchored at the
+    # acquisition node — fingerprints are caller-edit stable.
+    entry = _flow_entry_held(_resolve_sites(ctx, call_sites))
+    for fid, ref, sf, node, qn in acquisitions:
+        for src in sorted(entry.get(fid, frozenset())):
+            if src != ref.lid:
+                edges.append((src, ref.lid, sf, node, qn))
     order = {lid: i for i, lid in enumerate(ctx.decls.lock_order)}
     graph: Dict[str, Set[str]] = {}
     seen_edges: Set[Tuple[str, str, str]] = set()
@@ -384,22 +521,40 @@ def check_lock_order(ctx: Context) -> List[Finding]:
 
 class _RaceWalker:
     def __init__(self, sf: SourceFile, class_name: str, tc, func,
-                 qualname: str, decls, findings: List[Finding]):
+                 qualname: str, decls, findings: List[Finding],
+                 fid: Optional[str] = None, call_sites=None,
+                 report: bool = True):
         self.sf = sf
         self.class_name = class_name
         self.tc = tc
         self.qualname = qualname
         self.decls = decls
+        # candidate sink: (fid, lock attr, Finding) — check_races
+        # filters through the interprocedural guaranteed-held sets
         self.findings = findings
         self.recv = _receivers(class_name, func)
+        self.fid = fid
+        self.call_sites = call_sites  # [(fid, Call, frozenset attrs)]
+        self.report = report
 
     def _finding(self, node: ast.AST, attr: str, lock: str) -> None:
-        self.findings.append(Finding(
+        if not self.report:
+            return
+        self.findings.append((self.fid, lock, Finding(
             "race", self.sf.rel, getattr(node, "lineno", 0),
             self.qualname,
             f"mutation of {self.class_name}.{attr} outside "
             f"`with {lock}` — declared shared across threads",
-            self.sf.snippet(node)))
+            self.sf.snippet(node))))
+
+    def _record_calls(self, st: ast.stmt, held: Set[str]) -> None:
+        if self.call_sites is None or self.fid is None:
+            return
+        for e in _header_exprs(st):
+            hl = frozenset(held)
+            for n in ast.walk(e):
+                if isinstance(n, ast.Call):
+                    self.call_sites.append((self.fid, n, hl))
 
     def _guard(self, attr: Optional[str]) -> Optional[str]:
         if attr is None:
@@ -437,6 +592,7 @@ class _RaceWalker:
 
     def walk(self, stmts: List[ast.stmt], held: Set[str]) -> None:
         for st in stmts:
+            self._record_calls(st, held)
             if isinstance(st, (ast.With, ast.AsyncWith)):
                 got = set()
                 for item in st.items:
@@ -500,7 +656,9 @@ class _RaceWalker:
 
 
 def check_races(ctx: Context) -> List[Finding]:
-    findings: List[Finding] = []
+    candidates: List[tuple] = []   # (fid, lock attr, Finding)
+    call_sites: List[tuple] = []   # (fid, Call, frozenset held attrs)
+    callee_cls: Dict[str, str] = {}
     for sf in ctx.files:
         for cls in ast.walk(sf.tree):
             if not isinstance(cls, ast.ClassDef):
@@ -511,11 +669,33 @@ def check_races(ctx: Context) -> List[Finding]:
             for fn in cls.body:
                 if not isinstance(fn, FUNC_NODES):
                     continue
-                if fn.name in ("__init__", "__new__") \
-                        or fn.name in tc.exempt_methods:
-                    continue
-                w = _RaceWalker(sf, cls.name, tc, fn,
-                                f"{cls.name}.{fn.name}", ctx.decls,
-                                findings)
+                fid = f"{cls.name}.{fn.name}"
+                callee_cls[fid] = cls.name
+                # exempt bodies still contribute CALL SITES (their
+                # held-sets feed the intersection — a lock-free call
+                # from __init__ pessimizes a helper's guarantee,
+                # which is the safe direction), just no findings
+                report = not (fn.name in ("__init__", "__new__")
+                              or fn.name in tc.exempt_methods)
+                w = _RaceWalker(sf, cls.name, tc, fn, fid, ctx.decls,
+                                candidates, fid=fid,
+                                call_sites=call_sites, report=report)
                 w.walk(fn.body, set())
+    # interprocedural exoneration: a private helper whose every
+    # in-tree caller path holds the lock is clean — same-class edges
+    # only (held-sets are self-attr names, meaningless across classes)
+    resolved = [
+        (callee, caller, held)
+        for callee, caller, held in _resolve_sites(ctx, call_sites)
+        if callee_cls.get(caller) is not None
+        and callee.startswith(callee_cls[caller] + ".")]
+    guaranteed = _flow_guaranteed(resolved)
+    findings: List[Finding] = []
+    for fid, lock, f in candidates:
+        name = (fid or "").rsplit(".", 1)[-1]
+        private = name.startswith("_") and not name.startswith("__")
+        if fid is not None and private \
+                and lock in guaranteed.get(fid, frozenset()):
+            continue
+        findings.append(f)
     return findings

@@ -1,0 +1,180 @@
+"""Rule: no blocking calls reachable from the event loop (R10).
+
+The event-loop thread owns every socket: a blocking call on it —
+``os.fsync`` riding a WAL helper, ``time.sleep`` in a "quick" retry,
+``Lock.acquire()`` with no timeout on a contended lock, a blocking
+socket op — stalls ALL peers' I/O at once, which under load reads as
+a whole-cluster latency cliff rather than a bug on one path (the
+arXiv:1404.6719 pathology class: latent under clean timing).
+
+Roots are every ``async def`` in the tree plus any function handed to
+``call_soon`` / ``call_later`` / ``call_soon_threadsafe`` (those run
+ON the loop even though they are plain defs).  The rule then walks
+the shared call graph through BOTH sync and async callees and flags:
+
+* ``os.fsync`` / ``os.fdatasync``;
+* ``time.sleep`` (use ``asyncio.sleep`` on the loop);
+* ``subprocess.run/call/check_call/check_output``;
+* ``.acquire()`` without a ``timeout=`` kwarg on a declared lock
+  (``with lock:`` O(1) leaf sections are conventional and exempt —
+  the hazard is the unbounded bare acquire);
+* blocking methods (``recv/accept/connect/sendall/recvfrom``) on a
+  local variable assigned from ``socket.socket(...)``.
+
+``functools.partial(fn, ...)`` and lambda callbacks are looked
+through one level.  ``run_in_executor`` is the sanctioned escape
+hatch and is not a root.  Exemptions live in
+``decls.loopblock_exempt`` (same key forms as clock_exempt, why
+required, empty why does not exempt).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from gigapaxos_tpu.analysis.core import Context, Finding
+from gigapaxos_tpu.analysis.clockpurity import _is_exempt
+
+RULE = "loopblock"
+
+_SCHEDULERS = frozenset({"call_soon", "call_later",
+                         "call_soon_threadsafe", "call_at"})
+_OS_BLOCKING = frozenset({"fsync", "fdatasync"})
+_SUBPROC = frozenset({"run", "call", "check_call", "check_output"})
+_SOCK_BLOCKING = frozenset({"recv", "accept", "connect", "sendall",
+                            "recvfrom", "recv_into"})
+
+
+def _callback_target(arg: ast.AST) -> Optional[ast.AST]:
+    """The function expression a scheduler callback resolves to:
+    looks through ``functools.partial(fn, ...)`` and ``lambda``."""
+    if isinstance(arg, ast.Call):
+        f = arg.func
+        name = f.attr if isinstance(f, ast.Attribute) else (
+            f.id if isinstance(f, ast.Name) else None)
+        if name == "partial" and arg.args:
+            return arg.args[0]
+        return None
+    if isinstance(arg, ast.Lambda):
+        return arg.body
+    return arg
+
+
+def _decl_lock_attrs(decls) -> Set[str]:
+    out: Set[str] = set()
+    for tc in getattr(decls, "threaded", {}).values():
+        out |= set(tc.locks)
+    return out
+
+
+def _socket_locals(fn) -> Set[str]:
+    """Local names assigned from ``socket.socket(...)``."""
+    out: Set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and isinstance(node.value, ast.Call):
+            f = node.value.func
+            if (isinstance(f, ast.Attribute) and f.attr == "socket"
+                    and isinstance(f.value, ast.Name)
+                    and f.value.id == "socket") \
+                    or (isinstance(f, ast.Name)
+                        and f.id == "socket"):
+                out.add(node.targets[0].id)
+    return out
+
+
+def _blocking_calls(fi, lock_attrs: Set[str]) \
+        -> List[Tuple[ast.Call, str]]:
+    """(call node, description) for every blocking call in the body."""
+    out: List[Tuple[ast.Call, str]] = []
+    socks = _socket_locals(fi.func)
+    for node in ast.walk(fi.func):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if not isinstance(f, ast.Attribute):
+            continue
+        recv = f.value
+        rname = recv.id if isinstance(recv, ast.Name) else None
+        if rname == "os" and f.attr in _OS_BLOCKING:
+            out.append((node, f"os.{f.attr}()"))
+        elif rname == "time" and f.attr == "sleep":
+            out.append((node, "time.sleep()"))
+        elif rname == "subprocess" and f.attr in _SUBPROC:
+            out.append((node, f"subprocess.{f.attr}()"))
+        elif f.attr == "acquire":
+            # declared lock acquire with no timeout bound
+            attr = None
+            if isinstance(recv, ast.Attribute):
+                attr = recv.attr
+            elif isinstance(recv, ast.Subscript) \
+                    and isinstance(recv.value, ast.Attribute):
+                attr = recv.value.attr
+            if attr in lock_attrs and not any(
+                    kw.arg == "timeout" for kw in node.keywords):
+                out.append((node,
+                            f"{attr}.acquire() without a timeout"))
+        elif rname in socks and f.attr in _SOCK_BLOCKING:
+            out.append((node, f"blocking socket {f.attr}()"))
+    return out
+
+
+def check(ctx: Context) -> List[Finding]:
+    decls = ctx.decls
+    exempt: Dict[str, str] = getattr(decls, "loopblock_exempt", {}) \
+        or {}
+    lock_attrs = _decl_lock_attrs(decls)
+    cg = ctx.callgraph()
+
+    roots: List[str] = [fid for fid, fi in cg.funcs.items()
+                        if fi.is_async]
+    # plain defs scheduled onto the loop are loop code too
+    for fid, fi in cg.funcs.items():
+        for node in ast.walk(fi.func):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _SCHEDULERS
+                    and node.args):
+                continue
+            # call_later(delay, cb, ...) vs call_soon(cb, ...)
+            idx = 1 if node.func.attr in ("call_later", "call_at") \
+                else 0
+            if idx >= len(node.args):
+                continue
+            tgt = _callback_target(node.args[idx])
+            if tgt is None:
+                continue
+            callee = None
+            if isinstance(tgt, ast.Attribute) \
+                    or isinstance(tgt, ast.Name):
+                fake = ast.Call(func=tgt, args=[], keywords=[])
+                from gigapaxos_tpu.analysis.callgraph import \
+                    resolve_call
+                callee = resolve_call(cg, fi, fake)
+            if callee is not None:
+                roots.append(callee)
+
+    paths = cg.reach(sorted(set(roots)))
+    findings: List[Finding] = []
+    seen = set()
+    for fid in sorted(paths):
+        fi = cg.funcs[fid]
+        for node, what in _blocking_calls(fi, lock_attrs):
+            snippet = fi.sf.snippet(node)
+            if _is_exempt(exempt, fi.qualname, snippet):
+                continue
+            key = (fi.qualname, snippet)
+            if key in seen:
+                continue
+            seen.add(key)
+            chain = " -> ".join(paths[fid])
+            findings.append(Finding(
+                RULE, fi.sf.rel, getattr(node, "lineno", 0),
+                fi.qualname,
+                f"blocking {what} reachable from the event loop "
+                f"({chain}) — run it on a worker/executor or bound "
+                f"it, or declare the site in decls.loopblock_exempt",
+                snippet))
+    return findings

@@ -1,0 +1,151 @@
+"""Rule: scenario/harness global mutations must be finally-scoped (R11).
+
+The PR 6 incident generalized: a chaos scenario set
+``PC.ENGINE_SHARDS`` (process-global) and an early exception skipped
+the restore, so every later test inherited a resharded engine — the
+failure surfaced three tests downstream, green locally, red in CI.
+
+Within the declared scenario/harness files
+(``decls.reset_scope_files``), every call to a declared global
+mutator (``decls.reset_pairs``: ``Config.set``,
+``ChaosPlane.configure``, ...) must be *dominated by* a ``try`` whose
+``finally`` (its own, or an enclosing try's) calls one of the
+mutator's declared restorers.  "Dominated" is lexical: the mutation
+sits inside the try body (or a nested block of it), so no exception
+path can leave the process-global set without the finally running.
+
+Exemptions: ``decls.reset_exempt`` maps a qualname to a why (why
+required, empty why does not exempt) — for mutations whose restore
+provably happens in a caller's finally that the lexical check cannot
+see (dict-dispatched scenario bodies), or boot-time sets covered by
+the autouse conftest fixture.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from gigapaxos_tpu.analysis.core import Context, Finding, FUNC_NODES
+
+RULE = "resetscope"
+
+
+def _dotted(call: ast.Call) -> Optional[str]:
+    f = call.func
+    if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name):
+        return f"{f.value.id}.{f.attr}"
+    return None
+
+
+def _restorers_in(stmts: List[ast.stmt]) -> Set[str]:
+    out: Set[str] = set()
+    for st in stmts:
+        for node in ast.walk(st):
+            if isinstance(node, ast.Call):
+                d = _dotted(node)
+                if d is not None:
+                    out.add(d)
+    return out
+
+
+class _Walker:
+    """Tracks the stack of enclosing-finally restorer sets."""
+
+    def __init__(self, sf, qualname, pairs, exempt, findings):
+        self.sf = sf
+        self.qualname = qualname
+        self.pairs = pairs
+        self.exempt = exempt
+        self.findings = findings
+
+    def _exempted(self) -> bool:
+        why = self.exempt.get(self.qualname)
+        if why is None and "." in self.qualname:
+            why = self.exempt.get(self.qualname.split(".", 1)[1])
+        return bool((why or "").strip())
+
+    def walk(self, stmts: List[ast.stmt],
+             finals: Tuple[Set[str], ...]) -> None:
+        for st in stmts:
+            if isinstance(st, ast.Try):
+                inner = finals
+                if st.finalbody:
+                    inner = finals + (_restorers_in(st.finalbody),)
+                self.walk(st.body, inner)
+                for h in st.handlers:
+                    self.walk(h.body, inner)
+                self.walk(st.orelse, inner)
+                # the finalbody IS the restore scope: a mutator call
+                # in it sitting next to (or being) the restorer is
+                # the restore pattern, not a leak
+                self.walk(st.finalbody,
+                          finals + (_restorers_in(st.finalbody),))
+                continue
+            if isinstance(st, FUNC_NODES):
+                sub = _Walker(self.sf, f"{self.qualname}.{st.name}",
+                              self.pairs, self.exempt, self.findings)
+                sub.walk(st.body, ())
+                continue
+            if isinstance(st, (ast.If, ast.While)):
+                self._check_stmt(st.test, finals)
+                self.walk(st.body, finals)
+                self.walk(st.orelse, finals)
+            elif isinstance(st, (ast.For, ast.AsyncFor)):
+                self._check_stmt(st.iter, finals)
+                self.walk(st.body, finals)
+                self.walk(st.orelse, finals)
+            elif isinstance(st, (ast.With, ast.AsyncWith)):
+                for item in st.items:
+                    self._check_stmt(item.context_expr, finals)
+                self.walk(st.body, finals)
+            elif isinstance(st, ast.ClassDef):
+                pass
+            else:
+                self._check_stmt(st, finals)
+
+    def _check_stmt(self, st: ast.AST,
+                    finals: Tuple[Set[str], ...]) -> None:
+        for node in ast.walk(st):
+            if not isinstance(node, ast.Call):
+                continue
+            d = _dotted(node)
+            restorers = self.pairs.get(d)
+            if restorers is None:
+                continue
+            covered = any(r in fs for fs in finals for r in restorers)
+            if covered or self._exempted():
+                continue
+            self.findings.append(Finding(
+                RULE, self.sf.rel, getattr(node, "lineno", 0),
+                self.qualname,
+                f"process-global mutation {d}(...) is not dominated "
+                f"by a try/finally that calls one of "
+                f"{'/'.join(restorers)} — an exception here leaks "
+                f"the override into every later test/scenario",
+                self.sf.snippet(node)))
+
+
+def check(ctx: Context) -> List[Finding]:
+    decls = ctx.decls
+    scope: Tuple[str, ...] = getattr(decls, "reset_scope_files", ()) \
+        or ()
+    pairs: Dict[str, Tuple[str, ...]] = \
+        getattr(decls, "reset_pairs", {}) or {}
+    exempt: Dict[str, str] = getattr(decls, "reset_exempt", {}) or {}
+    if not scope or not pairs:
+        return []
+    findings: List[Finding] = []
+    for sf in ctx.files:
+        if not any(sf.rel.endswith(s) for s in scope):
+            continue
+        for node in sf.tree.body:
+            if isinstance(node, FUNC_NODES):
+                _Walker(sf, node.name, pairs, exempt,
+                        findings).walk(node.body, ())
+            elif isinstance(node, ast.ClassDef):
+                for fn in node.body:
+                    if isinstance(fn, FUNC_NODES):
+                        _Walker(sf, f"{node.name}.{fn.name}", pairs,
+                                exempt, findings).walk(fn.body, ())
+    return findings

@@ -1,0 +1,416 @@
+"""Rule: wire-plane encoder/decoder symmetry (R9).
+
+The PR 13 incident class: the FRAG codec is ~450 lines of paired
+pack/unpack arithmetic, and an asymmetry (a frame type without a
+decoder entry, a struct field packed in one order and unpacked in
+another, a column packer whose unpacker key went missing, a gated
+type the hello table forgot) never fails locally — it surfaces as a
+mixed-version interop corruption three deploys later.
+
+Checked against the literals in ``decls.wire.packets_rel``:
+
+* every ``PacketType`` member outside ``special_types`` has an entry
+  in the ``_DECODERS`` dispatch, the registered class exists, carries
+  ``TYPE = PacketType.<member>`` matching its key, and defines BOTH
+  ``encode`` and ``decode``;
+* scalar codecs (``_S = struct.Struct(fmt)``): the pack argument
+  count and the unpack target count both match the format's field
+  count, and when both sides name fields (``self.X`` pack args,
+  unpack targets fed positionally to ``cls(...)``) the field ORDER
+  agrees with the dataclass field order;
+* SoA codecs: the ordered ``np.ascontiguousarray(..., dtype)`` column
+  dtypes in ``encode`` match the ordered ``np.frombuffer(..., dtype)``
+  column dtypes in ``decode``;
+* ``_FRAG_PACKERS`` / ``_FRAG_UNPACKERS`` key sets are identical and
+  every registered packer/unpacker function exists;
+* every ``version_gated`` member is a key of the hello negotiation
+  table (``WIRE_GATED``), and every gate-table key is a real member;
+* every registered column packer/unpacker and XOR/delta helper
+  (``_xor_*``) is referenced by name in at least one test file — a
+  codec without a round-trip test is an asymmetry waiting to happen.
+"""
+
+from __future__ import annotations
+
+import ast
+import struct
+from typing import Dict, List, Optional, Set, Tuple
+
+from gigapaxos_tpu.analysis.core import (Context, Finding, FUNC_NODES,
+                                         SourceFile)
+
+RULE = "wiresym"
+
+
+def _fmt_fields(fmt: str) -> Optional[int]:
+    """Field count of a struct format ('<QQB' -> 3); None if weird."""
+    try:
+        n = len(struct.Struct(fmt).unpack(b"\0" * struct.calcsize(fmt)))
+        return n
+    except struct.error:
+        return None
+
+
+def _enum_members(tree: ast.Module, enum_name: str) -> Dict[str, int]:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef) and node.name == enum_name:
+            out = {}
+            for st in node.body:
+                if isinstance(st, ast.Assign) \
+                        and len(st.targets) == 1 \
+                        and isinstance(st.targets[0], ast.Name):
+                    out[st.targets[0].id] = st.lineno
+            return out
+    return {}
+
+
+def _dict_literal(tree: ast.Module, name: str) \
+        -> Optional[Tuple[ast.Dict, int]]:
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and node.targets[0].id == name \
+                and isinstance(node.value, ast.Dict):
+            return node.value, node.lineno
+    return None
+
+
+def _key_member(key: ast.AST, enum_name: str) -> Optional[str]:
+    """``PacketType.X`` / ``int(PacketType.X)`` / ``"X"`` -> "X"."""
+    if isinstance(key, ast.Call) and isinstance(key.func, ast.Name) \
+            and key.func.id == "int" and key.args:
+        key = key.args[0]
+    if isinstance(key, ast.Attribute) \
+            and isinstance(key.value, ast.Name) \
+            and key.value.id == enum_name:
+        return key.attr
+    if isinstance(key, ast.Constant) and isinstance(key.value, str):
+        return key.value
+    return None
+
+
+def _class_index(tree: ast.Module) -> Dict[str, ast.ClassDef]:
+    return {n.name: n for n in tree.body
+            if isinstance(n, ast.ClassDef)}
+
+
+def _codec_type(cls: ast.ClassDef, enum_name: str) -> Optional[str]:
+    for st in cls.body:
+        if isinstance(st, ast.Assign) and len(st.targets) == 1 \
+                and isinstance(st.targets[0], ast.Name) \
+                and st.targets[0].id == "TYPE":
+            return _key_member(st.value, enum_name)
+    return None
+
+
+def _method(cls: ast.ClassDef, name: str):
+    for st in cls.body:
+        if isinstance(st, FUNC_NODES) and st.name == name:
+            return st
+    return None
+
+
+def _struct_fmt(cls: ast.ClassDef) -> Optional[str]:
+    for st in cls.body:
+        if isinstance(st, ast.Assign) and len(st.targets) == 1 \
+                and isinstance(st.targets[0], ast.Name) \
+                and st.targets[0].id == "_S" \
+                and isinstance(st.value, ast.Call) \
+                and st.value.args \
+                and isinstance(st.value.args[0], ast.Constant) \
+                and isinstance(st.value.args[0].value, str):
+            return st.value.args[0].value
+    return None
+
+
+def _dataclass_fields(cls: ast.ClassDef) -> List[str]:
+    return [st.target.id for st in cls.body
+            if isinstance(st, ast.AnnAssign)
+            and isinstance(st.target, ast.Name)]
+
+
+def _s_pack_args(fn) -> Optional[List[ast.AST]]:
+    """Args of the ``self._S.pack(...)`` call in encode."""
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "pack" \
+                and isinstance(node.func.value, ast.Attribute) \
+                and node.func.value.attr == "_S":
+            return list(node.args)
+    return None
+
+
+def _s_unpack_targets(fn) -> Optional[List[str]]:
+    """Tuple target of ``... = cls._S.unpack_from(...)`` in decode."""
+    for node in ast.walk(fn):
+        if not (isinstance(node, ast.Assign)
+                and isinstance(node.value, ast.Call)
+                and isinstance(node.value.func, ast.Attribute)
+                and node.value.func.attr in ("unpack", "unpack_from")
+                and isinstance(node.value.func.value, ast.Attribute)
+                and node.value.func.value.attr == "_S"):
+            continue
+        tgt = node.targets[0]
+        if isinstance(tgt, (ast.Tuple, ast.List)):
+            return [e.id for e in tgt.elts
+                    if isinstance(e, ast.Name)]
+        if isinstance(tgt, ast.Name):
+            return [tgt.id]
+    return None
+
+
+def _ctor_args(fn, cls_name: str) -> Optional[List[ast.AST]]:
+    """Args of the final ``cls(...)`` / ``ClassName(...)`` build."""
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Return) and node.value is not None \
+                and isinstance(node.value, ast.Call):
+            f = node.value.func
+            if (isinstance(f, ast.Name) and f.id in ("cls", cls_name)):
+                return list(node.value.args)
+    return None
+
+
+def _np_dtype(expr: ast.AST) -> Optional[str]:
+    """``np.uint64`` / ``np.int32`` / ``"<u2"`` -> dtype label."""
+    if isinstance(expr, ast.Attribute) \
+            and isinstance(expr.value, ast.Name) \
+            and expr.value.id == "np":
+        return expr.attr
+    if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+        return expr.value
+    return None
+
+
+def _soa_encode_dtypes(fn) -> List[str]:
+    """Ordered dtypes of np.ascontiguousarray(col, dtype) in encode."""
+    out: List[Tuple[int, int, str]] = []
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "ascontiguousarray" \
+                and len(node.args) >= 2:
+            d = _np_dtype(node.args[1])
+            if d is not None:
+                out.append((node.lineno, node.col_offset, d))
+    return [d for _, _, d in sorted(out)]
+
+
+def _soa_decode_dtypes(fn) -> List[str]:
+    """Ordered dtypes of np.frombuffer(buf, dtype) in decode."""
+    out: List[Tuple[int, int, str]] = []
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "frombuffer" \
+                and len(node.args) >= 2:
+            d = _np_dtype(node.args[1])
+            if d is not None:
+                out.append((node.lineno, node.col_offset, d))
+    return [d for _, _, d in sorted(out)]
+
+
+def check(ctx: Context) -> List[Finding]:
+    wire = getattr(ctx.decls, "wire", None)
+    if wire is None:
+        return []
+    sf: Optional[SourceFile] = None
+    for f in ctx.files:
+        if f.rel.endswith(wire.packets_rel) \
+                or f.rel == wire.packets_rel:
+            sf = f
+            break
+    if sf is None:
+        return []
+    findings: List[Finding] = []
+
+    def add(node, qualname, msg):
+        findings.append(Finding(
+            RULE, sf.rel, getattr(node, "lineno", 0), qualname, msg,
+            sf.snippet(node) if hasattr(node, "lineno")
+            else qualname))
+
+    members = _enum_members(sf.tree, wire.enum_name)
+    classes = _class_index(sf.tree)
+    decoders = _dict_literal(sf.tree, wire.decoders_name)
+
+    # ---- frame-type <-> codec dispatch coverage ----------------------
+    dec_map: Dict[str, str] = {}
+    if decoders is None:
+        anchor = type("_n", (), {"lineno": 1})()
+        add(anchor, "<module>",
+            f"no {wire.decoders_name} dispatch dict literal found")
+    else:
+        dnode, _ = decoders
+        for k, v in zip(dnode.keys, dnode.values):
+            m = _key_member(k, wire.enum_name)
+            if m is None or m not in members:
+                add(k, wire.decoders_name,
+                    f"{wire.decoders_name} key is not a "
+                    f"{wire.enum_name} member")
+                continue
+            if not isinstance(v, ast.Name) or v.id not in classes:
+                add(v, wire.decoders_name,
+                    f"{wire.decoders_name}[{wire.enum_name}.{m}] does "
+                    f"not name a class defined in this module")
+                continue
+            dec_map[m] = v.id
+        for m, line in sorted(members.items()):
+            if m in wire.special_types or m in dec_map:
+                continue
+            anchor = type("_n", (), {"lineno": line})()
+            add(anchor, f"{wire.enum_name}.{m}",
+                f"frame type {wire.enum_name}.{m} has no "
+                f"{wire.decoders_name} entry — inbound frames of "
+                f"this type raise KeyError at decode")
+
+    # ---- per-codec encode/decode pairing + field symmetry ------------
+    for m, cname in sorted(dec_map.items()):
+        cls = classes[cname]
+        t = _codec_type(cls, wire.enum_name)
+        if t != m:
+            add(cls, cname,
+                f"codec {cname} is registered for {m} but declares "
+                f"TYPE = {t!r}")
+        enc = _method(cls, "encode")
+        dec = _method(cls, "decode")
+        if enc is None or dec is None:
+            add(cls, cname,
+                f"codec {cname} lacks a paired "
+                f"{'encode' if enc is None else 'decode'} — one-way "
+                f"frame types cannot round-trip")
+            continue
+        fmt = _struct_fmt(cls)
+        if fmt is not None:
+            nf = _fmt_fields(fmt)
+            pack_args = _s_pack_args(enc)
+            targets = _s_unpack_targets(dec)
+            if nf is not None and pack_args is not None \
+                    and len(pack_args) != nf:
+                add(enc, f"{cname}.encode",
+                    f"_S format {fmt!r} has {nf} field(s) but encode "
+                    f"packs {len(pack_args)}")
+            if nf is not None and targets is not None \
+                    and len(targets) != nf:
+                add(dec, f"{cname}.decode",
+                    f"_S format {fmt!r} has {nf} field(s) but decode "
+                    f"unpacks {len(targets)}")
+            # field-order agreement through the constructor
+            fields = _dataclass_fields(cls)
+            ctor = _ctor_args(dec, cname)
+            if pack_args is not None and targets is not None \
+                    and ctor is not None and fields:
+                attr_args = [a.attr for a in pack_args
+                             if isinstance(a, ast.Attribute)
+                             and isinstance(a.value, ast.Name)
+                             and a.value.id == "self"]
+                ctor_names = [a.id if isinstance(a, ast.Name) else None
+                              for a in ctor]
+                if len(attr_args) == len(pack_args) \
+                        and len(targets) == len(pack_args):
+                    for i, (packed, tname) in enumerate(
+                            zip(attr_args, targets)):
+                        if tname not in ctor_names:
+                            continue
+                        pos = ctor_names.index(tname)
+                        if pos < len(fields) \
+                                and fields[pos] != packed:
+                            add(dec, f"{cname}.decode",
+                                f"field order asymmetry: encode packs "
+                                f"self.{packed} at slot {i} but "
+                                f"decode feeds that slot into field "
+                                f"{fields[pos]!r}")
+        else:
+            e_dt = _soa_encode_dtypes(enc)
+            d_dt = _soa_decode_dtypes(dec)
+            if e_dt and d_dt and e_dt != d_dt:
+                add(dec, f"{cname}.decode",
+                    f"SoA column dtype order differs: encode writes "
+                    f"{e_dt} but decode reads {d_dt}")
+
+    # ---- packer/unpacker registry symmetry ---------------------------
+    mod_funcs: Set[str] = {n.name for n in sf.tree.body
+                           if isinstance(n, FUNC_NODES)}
+    helper_names: Set[str] = set()
+
+    def dict_keys_vals(name):
+        d = _dict_literal(sf.tree, name)
+        if d is None:
+            return None, None, None
+        node, line = d
+        keys, vals = {}, {}
+        for k, v in zip(node.keys, node.values):
+            m = _key_member(k, wire.enum_name)
+            if m is not None:
+                keys[m] = k
+                if isinstance(v, ast.Name):
+                    vals[m] = v.id
+        return keys, vals, node
+
+    pk_keys, pk_vals, pk_node = dict_keys_vals(wire.packers_name)
+    up_keys, up_vals, up_node = dict_keys_vals(wire.unpackers_name)
+    if pk_keys is not None and up_keys is not None:
+        for m in sorted(set(pk_keys) ^ set(up_keys)):
+            src = pk_keys.get(m) or up_keys.get(m)
+            missing = wire.unpackers_name if m in pk_keys \
+                else wire.packers_name
+            add(src, "<module>",
+                f"column codec asymmetry: {wire.enum_name}.{m} is "
+                f"registered in one direction only ({missing} has no "
+                f"entry) — packed members of this type cannot "
+                f"round-trip")
+        for m, fn_name in sorted({**(pk_vals or {}),
+                                  **(up_vals or {})}.items()):
+            helper_names.add(fn_name)
+        for m, fn_name in list((pk_vals or {}).items()) \
+                + list((up_vals or {}).items()):
+            if fn_name not in mod_funcs:
+                add(pk_node, "<module>",
+                    f"registered column codec {fn_name} is not "
+                    f"defined in this module")
+
+    # XOR/delta helpers always need round-trip coverage
+    helper_names.update(n for n in mod_funcs if n.startswith("_xor_"))
+
+    # ---- hello negotiation gate table --------------------------------
+    gate = _dict_literal(sf.tree, wire.gate_table)
+    if gate is None:
+        if wire.version_gated:
+            anchor = type("_n", (), {"lineno": 1})()
+            add(anchor, "<module>",
+                f"no {wire.gate_table} hello negotiation table — "
+                f"version-gated types "
+                f"({', '.join(sorted(wire.version_gated))}) have no "
+                f"declared minimum peer version")
+    else:
+        gnode, _ = gate
+        gkeys = set()
+        for k in gnode.keys:
+            m = _key_member(k, wire.enum_name)
+            if m is None or m not in members:
+                add(k, wire.gate_table,
+                    f"{wire.gate_table} key is not a "
+                    f"{wire.enum_name} member")
+            else:
+                gkeys.add(m)
+        for m in sorted(wire.version_gated - gkeys):
+            add(gnode, wire.gate_table,
+                f"version-gated type {wire.enum_name}.{m} missing "
+                f"from {wire.gate_table} — senders cannot tell which "
+                f"peers accept it")
+
+    # ---- round-trip test references ----------------------------------
+    test_src = "\n".join(f.src for f in ctx.usage_files
+                         if "/test" in f.rel or
+                         f.rel.startswith("test"))
+    for name in sorted(helper_names):
+        if name not in test_src:
+            fn_node = next((n for n in sf.tree.body
+                            if isinstance(n, FUNC_NODES)
+                            and n.name == name), None)
+            add(fn_node if fn_node is not None
+                else type("_n", (), {"lineno": 1})(),
+                name,
+                f"column/delta codec {name} has no test referencing "
+                f"it by name — every packer needs a round-trip test")
+    return findings

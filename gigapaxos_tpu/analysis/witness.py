@@ -1,0 +1,373 @@
+"""Runtime lock witness: proves the registry against real executions.
+
+The static layer (locks.py) checks the lock ORDER the source promises;
+this module checks the order the process actually EXHIBITS.  It is a
+lockdep-lite: every declared lock is wrapped in a :class:`WitnessLock`
+proxy that records, per thread, the stack of currently-held locks and
+— on each nested acquisition — an edge ``held -> acquired`` in a
+process-wide DAG, tagged with the acquire sites of both ends (full
+stack captured only on the FIRST observation of an edge, so the armed
+hot path stays one dict probe).
+
+:meth:`LockWitness.report` then cross-checks the observed DAG against
+``decls.lock_order`` / ``decls.leaf_locks``:
+
+* observed edge not implied by the declared order and not
+  into-a-leaf  -> **undeclared edge** (the registry is wrong or the
+  code is);
+* declared order edge / declared lock never observed -> **stale
+  warning** (the registry promises more than executions exercise);
+* any cycle in the observed DAG -> **hard failure**, with both edges'
+  acquire sites and first-observation stacks (this is a deadlock that
+  merely hasn't fired yet).
+
+Arming is opt-in via ``PC.LOCK_WITNESS`` (see ``PaxosNode.__init__``)
+or explicit :meth:`LockWitness.arm_node` / :meth:`arm_singletons`;
+``reset()`` unwraps everything it wrapped, so tests can arm freely.
+Per-element lids like ``PaxosNode._engine_locks[3]`` collapse to their
+base lid for the DAG (intra-family nesting is governed by the static
+indexed-lock discipline, not the witness).
+
+Witness sites are line-free (``file:function``) so a committed
+WITNESS_*.json artifact survives unrelated edits, mirroring the static
+layer's fingerprint discipline.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import traceback
+from typing import Dict, List, Optional, Tuple
+
+
+def _site() -> str:
+    """``file:function`` of the nearest non-witness caller frame."""
+    f = sys._getframe(1)
+    while f is not None and f.f_code.co_filename == __file__:
+        f = f.f_back
+    if f is None:  # pragma: no cover - interpreter shutdown
+        return "?"
+    return (f"{os.path.basename(f.f_code.co_filename)}"
+            f":{f.f_code.co_name}")
+
+
+def _stack() -> List[str]:
+    """Short line-numbered stack for first-observation edge records
+    (display only — never part of a stable fingerprint)."""
+    frames = [fr for fr in traceback.extract_stack()[:-1]
+              if os.path.basename(fr.filename) != "witness.py"]
+    return [f"{os.path.basename(fr.filename)}:{fr.lineno}:{fr.name}"
+            for fr in frames[-10:]]
+
+
+class WitnessLock:
+    """Transparent proxy over a ``threading.Lock``/``RLock`` that
+    reports successful acquisitions/releases to :class:`LockWitness`.
+    Unknown attributes delegate to the real lock, so RLock-only
+    methods keep working."""
+
+    __slots__ = ("_wl_real", "_wl_lid")
+
+    def __init__(self, real, lid: str):
+        self._wl_real = real
+        self._wl_lid = lid
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        ok = self._wl_real.acquire(blocking, timeout)
+        if ok:
+            LockWitness._note_acquire(self._wl_lid)
+        return ok
+
+    def release(self) -> None:
+        LockWitness._note_release(self._wl_lid)
+        self._wl_real.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def __repr__(self) -> str:
+        return f"<WitnessLock {self._wl_lid} {self._wl_real!r}>"
+
+    def __getattr__(self, name):
+        return getattr(self._wl_real, name)
+
+
+def _base(lid: str) -> str:
+    return lid.split("[", 1)[0]
+
+
+class LockWitness:
+    """Process-wide witness state.  Class singleton — no instances;
+    ``reset()`` restores every lock it wrapped (conftest calls it
+    between tests alongside the other singleton resets)."""
+
+    # guards the edge table and the restore list; deliberately NOT a
+    # WitnessLock (the witness never witnesses itself)
+    _mu = threading.Lock()
+    _tls = threading.local()
+    armed: bool = False
+    # (src_base, dst_base) -> edge record; plain-dict probe on the hot
+    # path, _mu only for first observation / snapshotting
+    edges: Dict[Tuple[str, str], dict] = {}
+    acquires: Dict[str, int] = {}
+    _restore: List[tuple] = []
+
+    # -- arming -------------------------------------------------------
+
+    @classmethod
+    def reset(cls) -> None:
+        with cls._mu:
+            for cont, key, orig in reversed(cls._restore):
+                try:
+                    if isinstance(cont, list):
+                        cont[key] = orig
+                    else:
+                        setattr(cont, key, orig)
+                except Exception:  # container died first: fine
+                    pass
+            cls._restore = []
+            cls.edges = {}
+            cls.acquires = {}
+            cls.armed = False
+        cls._tls = threading.local()
+
+    @classmethod
+    def _wrap(cls, cont, key, lid: str) -> None:
+        cur = cont[key] if isinstance(cont, list) \
+            else getattr(cont, key, None)
+        if cur is None or isinstance(cur, WitnessLock):
+            return
+        cls._restore.append((cont, key, cur))
+        wrapped = WitnessLock(cur, lid)
+        if isinstance(cont, list):
+            cont[key] = wrapped
+        else:
+            setattr(cont, key, wrapped)
+
+    @classmethod
+    def arm_node(cls, node) -> None:
+        """Wrap one PaxosNode's declared locks (engine lanes, stats,
+        group table, WAL/db, transport RTT, blackbox ring) plus the
+        process singletons.  Idempotent; called from
+        ``PaxosNode.__init__`` when ``PC.LOCK_WITNESS`` is on."""
+        with cls._mu:
+            cls.armed = True
+            for i in range(len(node._engine_locks)):
+                cls._wrap(node._engine_locks, i,
+                          f"PaxosNode._engine_locks[{i}]")
+            # keep the single-lane alias pointing at the wrapped lock
+            cls._restore.append((node, "_engine_lock",
+                                 node._engine_lock))
+            node._engine_lock = node._engine_locks[0]
+            cls._wrap(node, "_stat_lock", "PaxosNode._stat_lock")
+            cls._wrap(node.table, "_mut", "GroupTable._mut")
+            for i in range(len(node.logger._wal_locks)):
+                cls._wrap(node.logger._wal_locks, i,
+                          f"PaxosLogger._wal_locks[{i}]")
+            cls._wrap(node.logger, "_db_lock", "PaxosLogger._db_lock")
+            cls._wrap(node.transport, "_rtt_lock",
+                      "Transport._rtt_lock")
+            if getattr(node, "blackbox", None) is not None:
+                cls._wrap(node.blackbox, "_lock",
+                          "BlackboxRecorder._lock")
+            cls._arm_singletons_locked()
+
+    @classmethod
+    def arm_singletons(cls) -> None:
+        """Wrap just the class-singleton locks (profiler, instrument,
+        chaos, config, blackbox registry) — enough for unit tests that
+        never boot a node."""
+        with cls._mu:
+            cls.armed = True
+            cls._arm_singletons_locked()
+
+    @classmethod
+    def _arm_singletons_locked(cls) -> None:
+        from gigapaxos_tpu.blackbox.recorder import BlackboxRecorder
+        from gigapaxos_tpu.chaos.faults import ChaosPlane
+        from gigapaxos_tpu.utils.config import Config
+        from gigapaxos_tpu.utils.instrument import RequestInstrumenter
+        from gigapaxos_tpu.utils.profiler import DelayProfiler
+        cls._wrap(DelayProfiler, "_lock", "DelayProfiler._lock")
+        cls._wrap(RequestInstrumenter, "_lock",
+                  "RequestInstrumenter._lock")
+        cls._wrap(ChaosPlane, "_lock", "ChaosPlane._lock")
+        cls._wrap(Config, "_lock", "Config._lock")
+        cls._wrap(BlackboxRecorder, "_live_lock",
+                  "BlackboxRecorder._live_lock")
+
+    # -- recording (hot path) ----------------------------------------
+
+    @classmethod
+    def _note_acquire(cls, lid: str) -> None:
+        tls = cls._tls
+        held = getattr(tls, "held", None)
+        if held is None:
+            held = tls.held = []
+        base = _base(lid)
+        site = _site()
+        seen = set()
+        for h_lid, h_site in held:
+            hb = _base(h_lid)
+            # same-family nesting (engine_locks[2] under [0]) is the
+            # indexed-lock discipline's jurisdiction, not an edge
+            if hb == base or hb in seen:
+                continue
+            seen.add(hb)
+            cls._note_edge(hb, base, h_site, site)
+        # racy += is fine: coverage only needs >= 1 to land
+        cls.acquires[base] = cls.acquires.get(base, 0) + 1
+        held.append((lid, site))
+
+    @classmethod
+    def _note_release(cls, lid: str) -> None:
+        held = getattr(cls._tls, "held", None)
+        if held:
+            for i in range(len(held) - 1, -1, -1):
+                if held[i][0] == lid:
+                    del held[i]
+                    return
+
+    @classmethod
+    def _note_edge(cls, src: str, dst: str, src_site: str,
+                   dst_site: str) -> None:
+        key = (src, dst)
+        rec = cls.edges.get(key)
+        if rec is not None:
+            rec["count"] += 1
+            return
+        with cls._mu:
+            rec = cls.edges.get(key)
+            if rec is not None:
+                rec["count"] += 1
+                return
+            cls.edges[key] = {
+                "src": src, "dst": dst, "count": 1,
+                "src_site": src_site, "dst_site": dst_site,
+                "first_stack": _stack(),
+            }
+
+    # -- reporting ----------------------------------------------------
+
+    @classmethod
+    def report(cls, decls=None) -> dict:
+        """Cross-check observed DAG vs the declared registry; the
+        returned dict IS the WITNESS_*.json artifact schema."""
+        if decls is None:
+            from gigapaxos_tpu.analysis.decls import project_decls
+            decls = project_decls()
+        aliases = dict(getattr(decls, "lock_aliases", {}) or {})
+
+        def canon(b: str) -> str:
+            return aliases.get(b, b)
+
+        order = {canon(lid): i
+                 for i, lid in enumerate(decls.lock_order)}
+        leaves = {canon(lid) for lid in decls.leaf_locks}
+        with cls._mu:
+            recs = [dict(r) for r in cls.edges.values()]
+            acquires = dict(cls.acquires)
+        for r in recs:
+            r["src"], r["dst"] = canon(r["src"]), canon(r["dst"])
+        recs.sort(key=lambda r: (r["src"], r["dst"]))
+
+        undeclared = []
+        for r in recs:
+            a, b = r["src"], r["dst"]
+            if a in order and b in order and order[a] < order[b]:
+                continue  # implied by the declared global order
+            if b in leaves and a not in leaves:
+                continue  # any-held -> leaf is the leaf contract
+            undeclared.append(dict(
+                r, why=(
+                    f"observed {a} -> {b} "
+                    f"(acquired at {r['dst_site']} while "
+                    f"{r['src_site']} held) is neither implied by "
+                    f"decls.lock_order nor an into-leaf edge — "
+                    f"extend the registry or reorder the code")))
+
+        cycles = cls._cycles(recs)
+
+        stale = []
+        observed_keys = {(r["src"], r["dst"]) for r in recs}
+        lo = [canon(x) for x in decls.lock_order]
+        for i in range(len(lo) - 1):
+            if (lo[i], lo[i + 1]) not in observed_keys:
+                stale.append(f"declared order edge {lo[i]} -> "
+                             f"{lo[i + 1]} never observed")
+        for lid in sorted(set(lo) | leaves):
+            if not acquires.get(lid):
+                stale.append(f"declared lock {lid} never acquired")
+
+        return {
+            "schema": "gigapaxos_tpu.analysis/witness-v1",
+            "armed": cls.armed,
+            "acquires": dict(sorted(acquires.items())),
+            "edges": recs,
+            "undeclared_edges": undeclared,
+            "cycles": cycles,
+            "stale_warnings": stale,
+            "ok": not undeclared and not cycles,
+        }
+
+    @staticmethod
+    def _cycles(recs: List[dict]) -> List[dict]:
+        graph: Dict[str, List[str]] = {}
+        by_key = {}
+        for r in recs:
+            graph.setdefault(r["src"], []).append(r["dst"])
+            by_key[(r["src"], r["dst"])] = r
+        cycles: List[dict] = []
+        color: Dict[str, int] = {}
+        path: List[str] = []
+
+        def dfs(n: str) -> None:
+            color[n] = 1
+            path.append(n)
+            for m in sorted(graph.get(n, ())):
+                if color.get(m, 0) == 1:
+                    nodes = path[path.index(m):] + [m]
+                    cycles.append({
+                        "nodes": nodes,
+                        "edges": [by_key[(nodes[k], nodes[k + 1])]
+                                  for k in range(len(nodes) - 1)],
+                    })
+                elif color.get(m, 0) == 0:
+                    dfs(m)
+            path.pop()
+            color[n] = 2
+
+        for n in sorted(graph):
+            if color.get(n, 0) == 0:
+                dfs(n)
+        return cycles
+
+    @classmethod
+    def render(cls, rep: Optional[dict] = None) -> str:
+        """Human-readable summary (the __main__ driver prints this;
+        cycle reports carry BOTH edges' sites and stacks)."""
+        rep = rep if rep is not None else cls.report()
+        lines = [f"lock witness: {len(rep['edges'])} edge(s), "
+                 f"{sum(rep['acquires'].values())} acquisition(s) "
+                 f"across {len(rep['acquires'])} lock(s)"]
+        for e in rep["undeclared_edges"]:
+            lines.append(f"  UNDECLARED {e['src']} -> {e['dst']} "
+                         f"x{e['count']}: {e['why']}")
+        for c in rep["cycles"]:
+            lines.append(f"  CYCLE {' -> '.join(c['nodes'])}")
+            for e in c["edges"]:
+                lines.append(f"    {e['src']} (held from "
+                             f"{e['src_site']}) -> {e['dst']} "
+                             f"(acquired at {e['dst_site']})")
+                for fr in e["first_stack"]:
+                    lines.append(f"      {fr}")
+        for w in rep["stale_warnings"]:
+            lines.append(f"  stale: {w}")
+        return "\n".join(lines)

@@ -376,7 +376,9 @@ class Transport:
             return
         groups: Dict[int, list] = {}
         for dst, payload, preframed, nframes in items:
-            if not preframed and self.peer_wire.get(dst, 0) >= 1 \
+            if not preframed \
+                    and self.peer_wire.get(dst, 0) \
+                    >= pk.WIRE_GATED["FRAG"] \
                     and dst in self.addr_map:
                 g = groups.get(dst)
                 if g is None:

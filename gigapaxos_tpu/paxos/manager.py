@@ -613,6 +613,14 @@ class PaxosNode:
         # (entries leave at execution)
         self._forced_traces: Set[int] = set()
 
+        # opt-in runtime lock witness: wraps every declared lock above
+        # in a recording proxy so real executions prove (or refute)
+        # the analysis registry's declared order.  Last in __init__ so
+        # every lock it wraps already exists.
+        if Config.get(PC.LOCK_WITNESS):
+            from gigapaxos_tpu.analysis.witness import LockWitness
+            LockWitness.arm_node(self)
+
     # ------------------------------------------------------------------
     # per-processing-thread batch state (thread-local properties).
     # Handlers reference these as plain attributes; backing them with a

@@ -859,6 +859,16 @@ def shard_split(obj, shards: int) -> Dict[int, object]:
 
 WIRE_VERSION = 1
 
+# Version-gated frame types: a peer may only be sent one of these after
+# its WIRE_HELLO announced at least the listed wire version.  This table
+# IS the negotiation contract — senders consult it (transport coalescing
+# checks ``peer_wire[dst] >= WIRE_GATED["FRAG"]``) and the wiresym
+# analysis rule cross-checks it against ``decls.wire.version_gated`` so
+# a new gated frame type cannot ship without a negotiation entry.
+WIRE_GATED = {
+    "FRAG": 1,
+}
+
 _U16 = struct.Struct("<H")
 _U32 = struct.Struct("<I")
 _U64 = struct.Struct("<Q")

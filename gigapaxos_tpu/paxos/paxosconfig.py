@@ -202,3 +202,14 @@ class PC(ConfigKey):
     # zero-copy SoA receive: deliver each read chunk as ONE WireChunk
     # (blob + offset/type columns) instead of per-frame bytes slices
     WIRE_SOA_RX = True
+    # runtime lock witness (gigapaxos_tpu/analysis/witness.py): wrap
+    # every declared lock in a recording proxy and cross-check the
+    # OBSERVED acquisition DAG against decls.lock_order/leaf_locks —
+    # undeclared edges and cycles fail, declared-never-observed warns.
+    # Off by default (each armed acquire costs a dict probe + frame
+    # peek); tier-1 arms it for the witness drill and bin/check for
+    # the smoke subset.  Read once at node boot.
+    LOCK_WITNESS = False
+    # where the witness drill writes its WITNESS_*.json artifact
+    # ("" = artifacts/WITNESS_r01.json next to ANALYSIS_*.json)
+    WITNESS_OUT = ""

@@ -304,6 +304,7 @@ def render() -> str:
     out.extend(_chaos_rows())
     out.extend(_blackbox_rows())
     out.extend(_analysis_rows())
+    out.extend(_witness_rows())
 
     out.append("")
     out.append(END)
@@ -450,6 +451,35 @@ def _analysis_rows():
         + (f"; {base} baselined" if base else "")
         + f"; {art.get('elapsed_s')} s |"]
     return out
+
+
+def _witness_rows():
+    """Registry-coverage row from the newest tracked
+    ``WITNESS_*.json`` (`python -m gigapaxos_tpu.analysis
+    --witness-only`): what the armed chaos drill actually observed vs
+    what `analysis/decls.py` declares.  Undeclared edges or cycles
+    here mean the lock registry and the executable disagree."""
+    files = sorted(glob.glob(os.path.join(HERE, "WITNESS_*.json")))
+    if not files:
+        return []
+    name = os.path.basename(files[-1])
+    art = _load(name)
+    if not art:
+        return []
+    und = art.get("undeclared_edges", [])
+    cyc = art.get("cycles", [])
+    stale = art.get("stale_warnings", [])
+    drill = art.get("drill", {})
+    verdict = "**registry proven**" if art.get("ok") else (
+        f"**{len(und)} undeclared edge(s), {len(cyc)} cycle(s)**")
+    return [
+        f"| Lock witness, drill `{drill.get('scenario')}` seed "
+        f"{drill.get('seed')} (`{name}`) | {verdict}; "
+        f"{len(art.get('edges', []))} observed edge(s), "
+        f"{sum(art.get('acquires', {}).values())} acquisitions over "
+        f"{len(art.get('acquires', {}))} locks"
+        + (f"; {len(stale)} stale-registry warning(s)" if stale else "")
+        + f"; drill {drill.get('elapsed_s')} s |"]
 
 
 def main() -> int:

@@ -1,0 +1,31 @@
+"""Forged loopblock violations: blocking work reachable from the
+event loop.
+
+The async root never blocks directly — the ``os.fsync`` hides one
+call down in a sync helper, the ``time.sleep`` rides a plain def
+that ``call_soon`` schedules ONTO the loop, and the unbounded
+``acquire()`` sits in a second coroutine.
+"""
+import os
+import time
+
+
+class Node:
+    async def _drain(self):
+        self._flush_wal()            # sync helper, still on the loop
+
+    def _flush_wal(self):
+        os.fsync(self.fd)            # FIRES: one hop from an async def
+
+    def _arm(self, loop):
+        loop.call_soon(self._tick)   # plain def, runs ON the loop
+
+    def _tick(self):
+        time.sleep(0.01)             # FIRES: scheduled callback blocks
+
+    async def _commit(self):
+        self._lock.acquire()         # FIRES: unbounded on the loop
+        try:
+            self.n += 1
+        finally:
+            self._lock.release()

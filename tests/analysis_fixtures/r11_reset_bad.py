@@ -1,0 +1,9 @@
+"""Forged resetscope violation: a process-global override with no
+finally-scoped restore.  The trailing "restore" is not exception-safe
+— if ``run_wave`` raises, every later test inherits the override."""
+
+
+def scenario_resize(node):
+    Config.set("ENGINE_SHARDS", 8)   # FIRES: no try/finally dominates it
+    node.run_wave()
+    Config.set("ENGINE_SHARDS", 1)   # FIRES: too late, not a finally

@@ -1,0 +1,20 @@
+"""Clean twin: the override sits inside a try whose finally calls a
+declared restorer (the restore call in the finalbody IS the restore
+pattern, not a second leak), and the dict-dispatched body carries a
+declared exemption whose restore lives in the harness's finally."""
+
+
+def scenario_resize(node):
+    prior = Config.get("ENGINE_SHARDS")
+    try:
+        Config.set("ENGINE_SHARDS", 8)        # dominated by the finally
+        node.run_wave()
+    finally:
+        Config.set("ENGINE_SHARDS", prior)    # the restore pattern
+
+
+def dispatched(node):
+    # exempted in decls.reset_exempt: the harness restores across the
+    # dict dispatch in ITS finally, which the lexical check cannot see
+    Config.set("ENGINE_SHARDS", 2)
+    node.run_wave()

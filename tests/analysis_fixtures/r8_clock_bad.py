@@ -1,0 +1,26 @@
+"""Forged clockpurity violations: wall-clock reads on a wave path.
+
+The wave root ``_process`` never reads a clock itself — the reads
+hide one and two calls down, so only the transitive (call-graph)
+rule can see them.
+"""
+import time
+
+
+class Node:
+    def _now(self):
+        # the declared engine clock: sanctioned, never flagged
+        return time.monotonic()
+
+    def _process(self, frames):
+        self._stamp_batch(frames)
+
+    def _stamp_batch(self, frames):
+        t = time.time()          # FIRES: wall clock on a wave path
+        for f in frames:
+            f.ts = t
+        self._digest(frames)
+
+    def _digest(self, frames):
+        # two hops from the root: still on the wave path
+        return hash((len(frames), time.monotonic()))   # FIRES

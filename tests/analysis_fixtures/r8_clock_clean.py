@@ -1,0 +1,31 @@
+"""Clean twin: wave-visible time goes through the engine clock, the
+one wall-clock read left is a declared measurement-only exemption."""
+import time
+
+
+class Node:
+    def _now(self):
+        # the declared engine clock reads the wall; that's its job
+        return time.monotonic()
+
+    def _process(self, frames):
+        self._stamp_batch(frames)
+        t0 = time.monotonic()    # exempt: declared profiler span
+        self._profile(t0)
+
+    def _stamp_batch(self, frames):
+        t = self._now()          # sanctioned accessor
+        for f in frames:
+            f.ts = t
+        self._digest(frames)
+
+    def _digest(self, frames):
+        return hash((len(frames), self._now()))
+
+    def _profile(self, t0):
+        self.span = t0
+
+
+def offline_report():
+    # NOT reachable from the wave roots: free to read the wall
+    return time.time()

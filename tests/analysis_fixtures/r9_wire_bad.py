@@ -1,0 +1,56 @@
+"""Forged wiresym violations, one per check the rule makes:
+a frame type without a decoder, a codec registered under the wrong
+TYPE, a one-way codec, a struct-format/pack-arity mismatch, a
+one-direction column packer, a version-gated type missing from the
+negotiation table, and a delta helper with no round-trip test."""
+import struct
+
+
+class PacketType:
+    REQUEST = 1
+    PROPOSAL = 2
+    ORPHAN = 3        # FIRES: no _DECODERS entry
+    FRAG = 4
+
+
+class Request:
+    TYPE = PacketType.PROPOSAL    # FIRES: registered for REQUEST
+
+    _S = struct.Struct("<QQB")    # 3 fields
+
+    def encode(self):
+        return self._S.pack(self.gkey, self.req_id)  # FIRES: packs 2
+
+    @classmethod
+    def decode(cls, mv):
+        gkey, req_id, flags = cls._S.unpack_from(mv, 0)
+        return cls(gkey, req_id, flags)
+
+
+class Proposal:
+    TYPE = PacketType.PROPOSAL
+
+    def encode(self):             # FIRES: no paired decode
+        return b""
+
+
+_DECODERS = {
+    PacketType.REQUEST: Request,
+    PacketType.PROPOSAL: Proposal,
+}
+
+
+def _pack_req(n, body):
+    return body
+
+
+def _xor_sparse(prev, cur):       # FIRES: no test references it
+    return cur
+
+
+_FRAG_PACKERS = {
+    int(PacketType.REQUEST): _pack_req,   # FIRES: no unpacker twin
+}
+_FRAG_UNPACKERS = {}
+
+WIRE_GATED = {}                   # FIRES: FRAG missing from the table

@@ -1,0 +1,80 @@
+"""Clean twin: every frame type decodes, codecs pair both ways with
+agreeing formats and field orders, the packer registries mirror each
+other, the gated type sits in the negotiation table, and every
+helper is named by the round-trip test fixture."""
+import struct
+
+
+class PacketType:
+    REQUEST = 1
+    PROPOSAL = 2
+    FRAG = 4
+
+
+class Request:
+    gkey: int
+    req_id: int
+    flags: int
+
+    TYPE = PacketType.REQUEST
+
+    _S = struct.Struct("<QQB")
+
+    def encode(self):
+        return self._S.pack(self.gkey, self.req_id, self.flags)
+
+    @classmethod
+    def decode(cls, mv):
+        gkey, req_id, flags = cls._S.unpack_from(mv, 0)
+        return cls(gkey, req_id, flags)
+
+
+class Proposal:
+    TYPE = PacketType.PROPOSAL
+
+    def encode(self):
+        import numpy as np
+        a = np.ascontiguousarray(self.gkey, np.uint64)
+        b = np.ascontiguousarray(self.slot, np.int32)
+        return a.tobytes() + b.tobytes()
+
+    @classmethod
+    def decode(cls, mv):
+        import numpy as np
+        g = np.frombuffer(mv, np.uint64, 4, 0)
+        s = np.frombuffer(mv, np.int32, 4, 32)
+        return cls(g, s)
+
+
+_DECODERS = {
+    PacketType.REQUEST: Request,
+    PacketType.PROPOSAL: Proposal,
+}
+
+
+def _pack_req(n, body):
+    return body
+
+
+def _unpack_req(n, mv):
+    return bytes(mv)
+
+
+def _xor_sparse(prev, cur):
+    return cur
+
+
+def _xor_apply(prev, data):
+    return data
+
+
+_FRAG_PACKERS = {
+    int(PacketType.REQUEST): _pack_req,
+}
+_FRAG_UNPACKERS = {
+    int(PacketType.REQUEST): _unpack_req,
+}
+
+WIRE_GATED = {
+    "FRAG": 1,
+}

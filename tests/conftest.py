@@ -55,11 +55,24 @@ def _clean_config():
 
 @pytest.fixture(autouse=True)
 def _clean_profiler():
+    from gigapaxos_tpu.analysis.witness import LockWitness
     from gigapaxos_tpu.blackbox.recorder import BlackboxRecorder
     from gigapaxos_tpu.chaos.faults import ChaosPlane
     from gigapaxos_tpu.utils.instrument import RequestInstrumenter
     from gigapaxos_tpu.utils.profiler import DelayProfiler
     yield
+    # witness-armed runs (bin/check exports GP_PC_LOCK_WITNESS=1):
+    # fail the test whose execution exhibited an undeclared lock edge
+    # or cycle, THEN unwrap so later tests start on bare locks
+    if os.environ.get("GP_PC_LOCK_WITNESS") and LockWitness.armed:
+        rep = LockWitness.report()
+        rendered = LockWitness.render(rep)
+        LockWitness.reset()
+        assert rep["ok"], f"lock-witness violation:\n{rendered}"
+    else:
+        # unwrap any armed proxies FIRST so the singleton resets
+        # below run on the bare locks
+        LockWitness.reset()
     DelayProfiler.clear()
     # reset() also restores the trace-plane knobs (sample rate, age
     # horizon, slow log) a test may have configured via PC.TRACE_*

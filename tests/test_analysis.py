@@ -1,12 +1,18 @@
-"""Static-analysis suite: tier-1 gate + per-rule teeth/precision.
+"""Correctness-suite tests: tier-1 gate + per-rule teeth/precision
+for the static layer, plus witness-cycle teeth for the runtime layer.
 
 The gate test runs the full suite over ``gigapaxos_tpu/`` against the
 committed baseline and fails on any NEW finding — re-introducing the
-PR 5 ``sel`` shadowing bug or a bare lane-counter ``+=`` fails tier-1
-here.  The fixture tests prove every rule both fires on its forged
-bad sample (teeth) and stays quiet on the clean twin (precision).
+PR 5 ``sel`` shadowing bug, a bare lane-counter ``+=``, or a wall
+clock on a wave path fails tier-1 here.  The fixture tests prove
+every rule both fires on its forged bad sample (teeth) and stays
+quiet on the clean twin (precision); the witness tests prove an
+out-of-order acquisition on a background thread surfaces as a cycle
+naming both sites.
 """
 
+import json
+import threading
 import time
 from pathlib import Path
 
@@ -14,8 +20,9 @@ import pytest
 
 from gigapaxos_tpu.analysis import core
 from gigapaxos_tpu.analysis.decls import (Decls, HotPath,
-                                          ThreadedClass,
+                                          ThreadedClass, WireDecl,
                                           project_decls)
+from gigapaxos_tpu.analysis.witness import LockWitness, WitnessLock
 
 REPO = Path(__file__).resolve().parents[1]
 FIXTURES = Path(__file__).parent / "analysis_fixtures"
@@ -62,6 +69,38 @@ def _knob_decls() -> Decls:
     return Decls(knob_families={"CHAOS_": "ChaosPlane.reset"})
 
 
+def _clock_decls() -> Decls:
+    return Decls(
+        wave_roots=("Node._process",),
+        engine_clock="Node._now",
+        clock_exempt={"Node._process::monotonic":
+                      "declared profiler span: measurement only, "
+                      "never a frame field"})
+
+
+def _wire_decls() -> Decls:
+    # packets_rel=".py" so the suffix match picks up whichever single
+    # fixture file the Context holds
+    return Decls(wire=WireDecl(
+        packets_rel=".py",
+        special_types=frozenset({"FRAG"}),
+        version_gated=frozenset({"FRAG"})))
+
+
+def _loop_decls() -> Decls:
+    return Decls(threaded={"Node": ThreadedClass(
+        locks=frozenset({"_lock"}))})
+
+
+def _reset_decls() -> Decls:
+    return Decls(
+        reset_scope_files=("r11_reset_bad.py", "r11_reset_clean.py"),
+        reset_pairs={"Config.set": ("Config.clear", "Config.set")},
+        reset_exempt={"dispatched":
+                      "restored by the harness's finally across the "
+                      "dict dispatch"})
+
+
 _KNOB_DOC_BAD = "STALE_KNOB CHAOS_X"       # UNDOC_KNOB missing
 _KNOB_DOC_CLEAN = "GOOD_KNOB CHAOS_X"
 _CONFTEST_BAD = "def _fix():\n    Config.clear()\n"
@@ -87,6 +126,16 @@ _CASES = [
      {"doc_text": _KNOB_DOC_CLEAN, "conftest_src": _CONFTEST_CLEAN}),
     ("jit-purity", "r7_jit_bad.py", "r7_jit_clean.py",
      Decls, {}, {}),
+    ("clockpurity", "r8_clock_bad.py", "r8_clock_clean.py",
+     _clock_decls, {}, {}),
+    ("wiresym", "r9_wire_bad.py", "r9_wire_clean.py",
+     _wire_decls, {},
+     {"usage_files": [core.load_file(FIXTURES / "r9_wire_refs.py",
+                                     REPO)]}),
+    ("loopblock", "r10_loop_bad.py", "r10_loop_clean.py",
+     _loop_decls, {}, {}),
+    ("resetscope", "r11_reset_bad.py", "r11_reset_clean.py",
+     _reset_decls, {}, {}),
 ]
 
 
@@ -175,6 +224,175 @@ def test_reintroduced_sel_shadowing_fails(tmp_path):
     found = core.analyze(ctx, rules=["shadow"])
     assert any(f.qualname == "_rep_post" and "'sel'" in f.message
                for f in found)
+
+
+def test_reintroduced_wave_wall_clock_fails(tmp_path):
+    """The PR 8 incident: a wall-clock read hidden one call below a
+    wave root must fire under the REAL project declarations."""
+    bad = tmp_path / "manager_like.py"
+    bad.write_text(
+        "import time\n"
+        "class PaxosNode:\n"
+        "    def _process(self, frames):\n"
+        "        self._stamp(frames)\n"
+        "    def _stamp(self, frames):\n"
+        "        t = time.time()\n"
+        "        return t\n")
+    sf = core.load_file(bad, tmp_path)
+    ctx = core.Context(files=[sf], decls=project_decls(),
+                       root=tmp_path)
+    found = core.analyze(ctx, rules=["clockpurity"])
+    assert any(f.qualname == "PaxosNode._stamp" for f in found), \
+        "\n".join(f.render() for f in found)
+
+
+def test_interprocedural_fingerprint_survives_caller_drift(tmp_path):
+    """Editing the CALLER (moving the helper's lines) must not change
+    the interprocedural finding's fingerprint — else every unrelated
+    edit would invalidate baselines."""
+    helper = ("    def _stamp(self, frames):\n"
+              "        t = time.time()\n"
+              "        return t\n")
+    v1 = ("import time\n"
+          "class Node:\n"
+          "    def _process(self, frames):\n"
+          "        self._stamp(frames)\n" + helper)
+    v2 = ("import time\n"
+          "class Node:\n"
+          "    def _process(self, frames):\n"
+          "        pre = len(frames)\n"
+          "        if pre:\n"
+          "            frames = frames[:pre]\n"
+          "        self._stamp(frames)\n" + helper)
+    decls = Decls(wave_roots=("Node._process",),
+                  engine_clock="Node._now")
+    p = tmp_path / "node_like.py"
+    fps = []
+    for src in (v1, v2):
+        p.write_text(src)
+        sf = core.load_file(p, tmp_path)
+        ctx = core.Context(files=[sf], decls=decls, root=tmp_path)
+        found = core.analyze(ctx, rules=["clockpurity"])
+        assert len(found) == 1, "\n".join(f.render() for f in found)
+        fps.append(found[0].fingerprint)
+    assert fps[0] == fps[1], "caller edit changed the fingerprint"
+
+
+def test_wire_bad_fixture_covers_every_check():
+    ctx = _fixture_ctx("r9_wire_bad.py", _wire_decls())
+    msgs = "\n".join(f.message for f in core.analyze(
+        ctx, rules=["wiresym"]))
+    assert "ORPHAN" in msgs            # frame type with no decoder
+    assert "PROPOSAL" in msgs          # TYPE registered under REQUEST
+    assert "decode" in msgs            # one-way codec
+    assert "_pack_req" in msgs         # packer without unpacker twin
+    assert "WIRE_GATED" in msgs        # gated type off the table
+    assert "_xor_sparse" in msgs       # helper with no test reference
+
+
+# ---------------------------------------------------------------------------
+# runtime layer: the lock witness
+
+
+def _wit_reset():
+    LockWitness.reset()
+
+
+def test_witness_cycle_names_both_sites():
+    """Out-of-order acquisition on a background thread must surface
+    as a cycle whose report carries BOTH acquire sites — checked
+    against the real registry's declared order."""
+    _wit_reset()
+    try:
+        LockWitness.armed = True
+        eng = WitnessLock(threading.Lock(),
+                          "PaxosNode._engine_locks[0]")
+        mut = WitnessLock(threading.Lock(), "GroupTable._mut")
+        with eng:       # declared order: engine -> mut
+            with mut:
+                pass
+
+        def reversed_order():
+            with mut:   # the forged inversion
+                with eng:
+                    pass
+
+        t = threading.Thread(target=reversed_order)
+        t.start()
+        t.join()
+        rep = LockWitness.report(project_decls())
+        assert not rep["ok"]
+        assert rep["undeclared_edges"], LockWitness.render(rep)
+        assert rep["cycles"], LockWitness.render(rep)
+        nodes = rep["cycles"][0]["nodes"]
+        assert "PaxosNode._engine_locks" in nodes
+        assert "GroupTable._mut" in nodes
+        rendered = LockWitness.render(rep)
+        # both ends' acquire sites (file:function, line-free) named
+        for e in rep["cycles"][0]["edges"]:
+            assert ":" in e["src_site"] and ":" in e["dst_site"]
+            assert e["src_site"] in rendered
+            assert e["dst_site"] in rendered
+            assert e["first_stack"]
+    finally:
+        _wit_reset()
+
+
+def test_witness_into_leaf_and_reentrant_are_clean():
+    """Nesting into a declared leaf and re-entering the same indexed
+    family are both sanctioned — no undeclared edges."""
+    _wit_reset()
+    try:
+        LockWitness.armed = True
+        eng0 = WitnessLock(threading.RLock(),
+                           "PaxosNode._engine_locks[0]")
+        eng3 = WitnessLock(threading.RLock(),
+                           "PaxosNode._engine_locks[3]")
+        wal = WitnessLock(threading.Lock(),
+                          "PaxosLogger._wal_locks[0]")
+        with eng0:
+            with eng3:        # same base: indexed-lock jurisdiction
+                with wal:     # into a declared leaf
+                    pass
+        rep = LockWitness.report(project_decls())
+        assert rep["ok"], LockWitness.render(rep)
+        assert not rep["undeclared_edges"]
+        keys = {(e["src"], e["dst"]) for e in rep["edges"]}
+        assert ("PaxosNode._engine_locks",
+                "PaxosLogger._wal_locks") in keys
+    finally:
+        _wit_reset()
+
+
+def test_witness_reset_unwraps():
+    class Holder:
+        pass
+
+    h = Holder()
+    h._lock = threading.Lock()
+    orig = h._lock
+    with LockWitness._mu:
+        LockWitness._wrap(h, "_lock", "Holder._lock")
+    assert isinstance(h._lock, WitnessLock)
+    LockWitness.reset()
+    assert h._lock is orig
+
+
+def test_committed_witness_artifact_proves_registry():
+    """The committed drill artifact must exist and be clean — the
+    render_perf registry-coverage row reads it."""
+    p = REPO / "WITNESS_r01.json"
+    assert p.is_file(), "run: python -m gigapaxos_tpu.analysis " \
+                        "--witness-only"
+    rep = json.loads(p.read_text())
+    assert rep["schema"] == "gigapaxos_tpu.analysis/witness-v1"
+    assert rep["ok"] and not rep["undeclared_edges"] \
+        and not rep["cycles"]
+    assert sum(rep["acquires"].values()) > 0
+    # witness sites are line-free so the artifact survives drift
+    for e in rep["edges"]:
+        assert e["src_site"].count(":") == 1
+        assert e["dst_site"].count(":") == 1
 
 
 # ---------------------------------------------------------------------------
