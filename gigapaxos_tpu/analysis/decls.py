@@ -141,7 +141,8 @@ def project_decls() -> Decls:
                 "n_executed", "n_decided", "n_paused", "n_unpaused",
                 "n_redriven", "n_parked", "n_park_dropped",
                 "n_redrive_capped", "n_installs", "n_ballot_changes",
-                "n_shed")},
+                "n_shed", "n_shed_disk", "n_wal_nacked",
+                "_degraded_seen")},
         ),
         # name/row registry: lane workers resolve while the loop
         # creates/deletes
@@ -160,10 +161,20 @@ def project_decls() -> Decls:
         # handle one db lock.  _wals is guarded because compaction
         # swaps handles in place — writers must re-read the slot
         # under the segment lock (the closed-handle race fixed
-        # alongside this suite)
+        # alongside this suite).  _gen rides the same contract:
+        # fsync-failure rotation bumps the generation while holding
+        # the segment lock.  The health flags (degraded / disk-full /
+        # rotation and quarantine tallies) are written from writer
+        # threads and read by the stats listener, so they get their
+        # own innermost _health_lock — nested inside the segment/db
+        # sections that discover the faults
         "PaxosLogger": ThreadedClass(
-            locks=frozenset({"_wal_locks", "_db_lock"}),
-            guarded={"_wals": "_wal_locks"},
+            locks=frozenset({"_wal_locks", "_db_lock",
+                             "_health_lock"}),
+            guarded={**{a: "_wal_locks" for a in ("_wals", "_gen")},
+                     **{a: "_health_lock" for a in
+                        ("_degraded", "_disk_full", "_rotations",
+                         "_quarantined", "_ckpt_bad")}},
         ),
         # class-attribute singletons: every update hook may be hit
         # from any stage thread
@@ -186,6 +197,16 @@ def project_decls() -> Decls:
                      ("_rules", "_blocked", "_rngs", "_per_pair",
                       "n_dropped", "n_blocked", "n_delayed",
                       "n_reordered", "enabled", "seed")},
+        ),
+        # storage fault plane: on_fsync/on_append run on WAL writer
+        # threads (under the segment lock) while scenarios configure
+        # rules from the harness thread
+        "StorageChaos": ThreadedClass(
+            locks=frozenset({"_lock"}),
+            guarded={a: "_lock" for a in
+                     ("_rules", "_rngs", "_poisoned", "_per_pair",
+                      "n_fsync_eio", "n_enospc", "n_slow",
+                      "n_torn", "enabled", "seed")},
         ),
         "Config": ThreadedClass(
             locks=frozenset({"_lock"}),
@@ -222,6 +243,11 @@ def project_decls() -> Decls:
         "Frag.encode": HotPath("lean"),
         "Frag.split": HotPath("lean"),
         "ChaosPlane.on_send": HotPath("lean"),
+        # storage fault hooks sit on every WAL fsync/append; one
+        # class-attribute check when the plane is off
+        "StorageChaos.on_fsync": HotPath("lean"),
+        "StorageChaos.on_append": HotPath("lean"),
+        "StorageChaos.is_poisoned": HotPath("lean"),
         # per-request tracing hooks: one attribute check when off
         "RequestInstrumenter.record": HotPath(
             "gate_first", gates=("enabled",)),
@@ -265,20 +291,27 @@ def project_decls() -> Decls:
         hot_paths=hot_paths,
         # engine lane locks are outermost (they serialize the lane
         # against control-plane ops), then the group table's mutation
-        # lock; stat/profiler/instrument/chaos locks are leaves
+        # lock, then the WAL segment/db sections (WITNESS_r01 showed
+        # the lanes nest them inside the lane lock on every durable
+        # wave; the storage fault plane demoted them from leaves —
+        # they now nest the health flag and StorageChaos leaves when
+        # a write discovers a fault); stat/profiler/instrument/chaos
+        # locks are leaves
         lock_order=("PaxosNode._engine_locks", "GroupTable._mut",
+                    "PaxosLogger._wal_locks", "PaxosLogger._db_lock",
+                    "PaxosLogger._health_lock",
                     "PaxosNode._stat_lock"),
         leaf_locks=frozenset({
             "PaxosNode._stat_lock", "Transport._rtt_lock",
             "DelayProfiler._lock", "RequestInstrumenter._lock",
             "ChaosPlane._lock", "Config._lock",
             "BlackboxRecorder._lock", "BlackboxRecorder._live_lock",
-            # added by the first lock-witness drill (WITNESS_r01): the
-            # engine lanes nest WAL-segment and sqlite sections inside
-            # the lane lock on every durable wave — both sections are
-            # self-contained (no lock acquired inside), so they are
-            # leaves the registry had simply never declared
-            "PaxosLogger._wal_locks", "PaxosLogger._db_lock",
+            # the WAL health flags and the storage fault plane are the
+            # new innermost sections: a writer that trips EIO/ENOSPC
+            # records it while still holding the segment/db lock, so
+            # those two moved into lock_order above and these O(1)
+            # regions became the leaves
+            "PaxosLogger._health_lock", "StorageChaos._lock",
         }),
         indexed_locks={
             "PaxosNode._engine_locks": ("_locks_for",),
@@ -288,6 +321,10 @@ def project_decls() -> Decls:
                       "PaxosNode._engine_locks"},
         knob_families={
             "CHAOS_": "ChaosPlane.reset",
+            "STORAGE_CHAOS_": "StorageChaos.reset",
+            # read once at logger construction into per-node state,
+            # torn down with the node; Config.clear() is enough
+            "WAL_CRC": None,
             "BLACKBOX_": "BlackboxRecorder.reset",
             "TRACE_": "RequestInstrumenter.reset",
             "SLOW_TRACE_": "RequestInstrumenter.reset",
@@ -355,6 +392,10 @@ def project_decls() -> Decls:
                 "fault-injection delay arithmetic; chaos runs are "
                 "seed-deterministic via their own rng, and the engine "
                 "digests are taken on the frames it delivers",
+            "StorageChaos.*":
+                "slow-fsync delay arithmetic (sleep injection); the "
+                "fault schedule itself is seed-deterministic via the "
+                "per-(node,segment) rng streams",
         },
         # -- loopblock --------------------------------------------------
         loopblock_exempt={},
@@ -371,6 +412,10 @@ def project_decls() -> Decls:
                                     "ChaosPlane.heal"),
             "ChaosPlane.partition": ("ChaosPlane.reset",
                                      "ChaosPlane.heal"),
+            "StorageChaos.configure": ("StorageChaos.reset",),
+            "StorageChaos.set_rule": ("StorageChaos.reset",
+                                      "StorageChaos.clear",
+                                      "StorageChaos.set_rule"),
         },
         reset_exempt={
             "PaxosEmulation.__init__":
@@ -393,6 +438,12 @@ def project_decls() -> Decls:
             "_sc_mini_partition_heal":
                 "chaos rules restored by run_scenario's finally "
                 "(ChaosPlane.reset) across the dict dispatch",
+            "_sc_disk_storm":
+                "storage rules restored by run_scenario's finally "
+                "(StorageChaos.reset) across the dict dispatch",
+            "_sc_mini_disk_fault":
+                "storage rules restored by run_scenario's finally "
+                "(StorageChaos.reset) across the dict dispatch",
         },
         wire=WireDecl(),
     )

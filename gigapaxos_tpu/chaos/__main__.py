@@ -24,9 +24,9 @@ import time
 from gigapaxos_tpu.chaos.scenarios import SCENARIOS, run_scenario
 
 # the full drill (the default): every full-size scenario; 'all' adds
-# mini_partition_heal, the smoke-gate variant of partition_heal
+# the smoke-gate mini variants (mini_partition_heal, mini_disk_fault)
 DEFAULT = ["partition_heal", "leader_crash", "rolling_restart",
-           "shard_storm", "zipf_hot"]
+           "shard_storm", "zipf_hot", "disk_storm"]
 
 
 def main(argv=None) -> int:

@@ -410,3 +410,359 @@ class ChaosPlane:
                     json.dumps({"err": str(exc)}).encode())
         return ("200 OK", "application/json",
                 json.dumps(cls.snapshot()).encode())
+
+
+class StorageRule:
+    """Fault parameters for one (possibly wildcard) (node, segment)."""
+
+    __slots__ = ("fsync_eio_p", "fsync_persist", "enospc_p",
+                 "fsync_delay_s", "fsync_jitter_s", "torn_p")
+
+    def __init__(self, fsync_eio_p: float = 0.0,
+                 fsync_persist: bool = False, enospc_p: float = 0.0,
+                 fsync_delay_s: float = 0.0, fsync_jitter_s: float = 0.0,
+                 torn_p: float = 0.0):
+        self.fsync_eio_p = min(1.0, max(0.0, float(fsync_eio_p)))
+        self.fsync_persist = bool(fsync_persist)
+        self.enospc_p = min(1.0, max(0.0, float(enospc_p)))
+        self.fsync_delay_s = max(0.0, float(fsync_delay_s))
+        self.fsync_jitter_s = max(0.0, float(fsync_jitter_s))
+        self.torn_p = min(1.0, max(0.0, float(torn_p)))
+
+    def asdict(self) -> dict:
+        return {"fsync_eio": self.fsync_eio_p,
+                "fsync_persist": self.fsync_persist,
+                "enospc": self.enospc_p,
+                "fsync_delay_ms": round(self.fsync_delay_s * 1e3, 3),
+                "fsync_jitter_ms": round(self.fsync_jitter_s * 1e3, 3),
+                "torn": self.torn_p}
+
+
+class StorageChaos:
+    """The disk sibling of :class:`ChaosPlane`: deterministic fault
+    injection on the WAL/checkpoint IO path, keyed per
+    ``(node, segment)`` with the same seeded golden-ratio discipline
+    (per-pair PRNG streams consumed in that lane's IO order, pure
+    :meth:`_decide` shared with :meth:`schedule_fingerprint`).
+
+    Verdicts the :class:`~gigapaxos_tpu.paxos.logger.PaxosLogger` shim
+    consults (only while :attr:`enabled` — disabled costs the fsync
+    path one class-attribute check):
+
+    - :meth:`on_fsync` — EIO (transient, or latched persistent so the
+      rotated-to generation fails too: the degraded-mode driver) and
+      slow-fsync latency
+    - :meth:`on_append` — ENOSPC, and short/torn appends (only a
+      prefix of the buffer lands, the crash shape recovery's torn-tail
+      check absorbs)
+
+    Post-crash bit-flip corruption at a chosen record is the offline
+    half of the plane: ``paxos.logger.corrupt_wal_record`` flips bytes
+    in a segment file while the node is down (scenarios call it
+    between kill and restart).
+    """
+
+    enabled: bool = False
+
+    seed: int = 0
+    _lock = threading.Lock()
+    # (node|None, seg|None) -> StorageRule; None = wildcard
+    _rules: Dict[Tuple[Optional[int], Optional[int]], StorageRule] = {}
+    _rngs: Dict[Tuple[int, int], Random] = {}   # lazily minted per pair
+    # persistent-EIO latch: once an fsync fails on a pair under a
+    # fsync_persist rule, every later fsync there fails too — across
+    # handle rotation (the fd is new, the device is still broken)
+    _poisoned: Set[Tuple[int, int]] = set()
+    n_fsync_eio: int = 0
+    n_enospc: int = 0
+    n_slow: int = 0
+    n_torn: int = 0
+    _per_pair: Dict[Tuple[int, int], List[int]] = {}  # [eio,nospc,slow,torn]
+
+    # -- configuration -----------------------------------------------------
+
+    @classmethod
+    def configure(cls, seed: Optional[int] = None,
+                  enabled: Optional[bool] = None) -> None:
+        with cls._lock:
+            if seed is not None:
+                cls.seed = int(seed)
+                cls._rngs.clear()  # new seed -> fresh decision streams
+            if enabled is not None:
+                cls.enabled = bool(enabled)
+
+    @classmethod
+    def set_rule(cls, node: Optional[int], seg: Optional[int],
+                 fsync_eio_p: float = 0.0, fsync_persist: bool = False,
+                 enospc_p: float = 0.0, fsync_delay_s: float = 0.0,
+                 fsync_jitter_s: float = 0.0,
+                 torn_p: float = 0.0) -> None:
+        """Install a fault rule for ``(node, seg)`` (``None`` =
+        wildcard on that side).  A rule with every probability and
+        delay zero removes the entry.  Enables the plane."""
+        key = (None if node is None else int(node),
+               None if seg is None else int(seg))
+        rule = StorageRule(fsync_eio_p, fsync_persist, enospc_p,
+                           fsync_delay_s, fsync_jitter_s, torn_p)
+        with cls._lock:
+            if rule.fsync_eio_p or rule.enospc_p or rule.fsync_delay_s \
+                    or rule.fsync_jitter_s or rule.torn_p:
+                cls._rules[key] = rule
+                cls.enabled = True
+            else:
+                cls._rules.pop(key, None)
+
+    @classmethod
+    def clear(cls) -> None:
+        """Remove all rules, latches, and counters; disable."""
+        with cls._lock:
+            cls._rules.clear()
+            cls._rngs.clear()
+            cls._poisoned.clear()
+            cls._per_pair.clear()
+            cls.n_fsync_eio = cls.n_enospc = 0
+            cls.n_slow = cls.n_torn = 0
+            cls.enabled = False
+
+    @classmethod
+    def reset(cls) -> None:
+        """clear() + default seed (the test-harness hygiene hook)."""
+        cls.clear()
+        with cls._lock:
+            cls.seed = 0
+
+    @classmethod
+    def configure_from_pc(cls) -> None:
+        """Mirror the ``PC.STORAGE_CHAOS_*`` knobs into the plane at
+        node boot (only-enable, like ``ChaosPlane``)."""
+        from gigapaxos_tpu.paxos.paxosconfig import PC
+        from gigapaxos_tpu.utils.config import Config
+        seed = int(Config.get(PC.STORAGE_CHAOS_SEED))
+        eio = float(Config.get(PC.STORAGE_CHAOS_FSYNC_EIO))
+        persist = bool(Config.get(PC.STORAGE_CHAOS_FSYNC_PERSIST))
+        enospc = float(Config.get(PC.STORAGE_CHAOS_ENOSPC))
+        delay = float(Config.get(PC.STORAGE_CHAOS_FSYNC_DELAY_MS)) / 1e3
+        jitter = float(
+            Config.get(PC.STORAGE_CHAOS_FSYNC_JITTER_MS)) / 1e3
+        torn = float(Config.get(PC.STORAGE_CHAOS_TORN))
+        if seed:
+            cls.configure(seed=seed)
+        if eio or enospc or delay or jitter or torn:
+            cls.set_rule(None, None, fsync_eio_p=eio,
+                         fsync_persist=persist, enospc_p=enospc,
+                         fsync_delay_s=delay, fsync_jitter_s=jitter,
+                         torn_p=torn)
+
+    # -- the logger-facing verdicts ----------------------------------------
+
+    @classmethod
+    def _rule_for(cls, node: int, seg: int) -> Optional[StorageRule]:
+        """Most-specific wins: (n,s) > (n,*) > (*,s) > (*,*).
+        Caller holds the lock."""
+        r = cls._rules
+        return (r.get((node, seg)) or r.get((node, None))
+                or r.get((None, seg)) or r.get((None, None)))
+
+    @classmethod
+    def _decide(cls, rule: Optional[StorageRule],
+                rng: Random) -> Tuple[bool, bool, float, float]:
+        """(fsync_eio, enospc, fsync_delay_s, torn_frac) for one IO op
+        under ``rule``; ``torn_frac`` is 0.0 (not torn) or the fraction
+        of the buffer that lands.  Pure in (rule, rng state) — shared
+        by the live path and the fingerprint."""
+        if rule is None:
+            return False, False, 0.0, 0.0
+        eio = bool(rule.fsync_eio_p) and rng.random() < rule.fsync_eio_p
+        enospc = bool(rule.enospc_p) and rng.random() < rule.enospc_p
+        delay = rule.fsync_delay_s
+        if rule.fsync_jitter_s:
+            delay += rule.fsync_jitter_s * rng.random()
+        torn = 0.0
+        if rule.torn_p and rng.random() < rule.torn_p:
+            torn = rng.random()
+        return eio, enospc, delay, torn
+
+    @classmethod
+    def _pair_state(cls, pair: Tuple[int, int]):
+        """(rule, rng) for a pair, minting the rng lazily.
+        Caller holds the lock."""
+        rule = cls._rule_for(*pair)
+        if rule is None:
+            return None, None
+        rng = cls._rngs.get(pair)
+        if rng is None:
+            rng = cls._rngs[pair] = Random(_pair_seed(cls.seed, *pair))
+        return rule, rng
+
+    @classmethod
+    def on_fsync(cls, node: int, seg: int) -> Tuple[bool, float]:
+        """Verdict for one fsync on ``(node, seg)``:
+        ``(fail_with_eio, delay_s)``."""
+        pair = (int(node), int(seg))
+        with cls._lock:
+            if pair in cls._poisoned:
+                cls.n_fsync_eio += 1
+                cls._per_pair.setdefault(pair, [0, 0, 0, 0])[0] += 1
+                return True, 0.0
+            rule, rng = cls._pair_state(pair)
+            if rule is None:
+                return False, 0.0
+            eio, _enospc, delay, _torn = cls._decide(rule, rng)
+            if eio:
+                cls.n_fsync_eio += 1
+                cls._per_pair.setdefault(pair, [0, 0, 0, 0])[0] += 1
+                if rule.fsync_persist:
+                    cls._poisoned.add(pair)
+                return True, 0.0
+            if delay > 0.0:
+                cls.n_slow += 1
+                cls._per_pair.setdefault(pair, [0, 0, 0, 0])[2] += 1
+            return False, delay
+
+    @classmethod
+    def is_poisoned(cls, node: int, seg: int) -> bool:
+        """Latch-only query (no PRNG draw): has a persistent-EIO rule
+        latched this pair's device dead?  The logger's ROTATION path
+        asks this instead of :meth:`on_fsync` — a transient EIO models
+        a one-shot error reported against the old fd's dirty pages, so
+        a fresh handle succeeds and rotation saves the batch; only a
+        latched (whole-device) failure makes rotation fail too and tips
+        the node into degraded mode.  Keeping the query draw-free also
+        keeps each pair's decision stream (and with it
+        :meth:`schedule_fingerprint`) independent of rotation timing."""
+        pair = (int(node), int(seg))
+        with cls._lock:
+            if pair not in cls._poisoned:
+                return False
+            cls.n_fsync_eio += 1
+            cls._per_pair.setdefault(pair, [0, 0, 0, 0])[0] += 1
+            return True
+
+    @classmethod
+    def on_append(cls, node: int, seg: int,
+                  nbytes: int) -> Tuple[bool, int]:
+        """Verdict for one append of ``nbytes`` on ``(node, seg)``:
+        ``(fail_with_enospc, bytes_that_land)``.  A torn verdict keeps
+        only a proper prefix (never the full buffer, never on a
+        1-byte write)."""
+        pair = (int(node), int(seg))
+        with cls._lock:
+            rule, rng = cls._pair_state(pair)
+            if rule is None:
+                return False, nbytes
+            _eio, enospc, _delay, torn = cls._decide(rule, rng)
+            if enospc:
+                cls.n_enospc += 1
+                cls._per_pair.setdefault(pair, [0, 0, 0, 0])[1] += 1
+                return True, nbytes
+            if torn > 0.0 and nbytes > 1:
+                cls.n_torn += 1
+                cls._per_pair.setdefault(pair, [0, 0, 0, 0])[3] += 1
+                return False, max(1, min(nbytes - 1,
+                                         int(nbytes * torn)))
+            return False, nbytes
+
+    # -- replay proof -------------------------------------------------------
+
+    @classmethod
+    def schedule_fingerprint(cls, pairs: List[Tuple[int, int]],
+                             k: int = 256) -> str:
+        """Digest of the first ``k`` would-be decisions per
+        ``(node, seg)`` pair under the CURRENT rules and seed, from
+        fresh PRNGs (live streams are not consumed).  The persistent-
+        EIO latch set is folded in too: it evolves deterministically
+        from the decision stream, so identical replays latch
+        identically."""
+        acc = _pair_seed(cls.seed, 0, 0)
+        with cls._lock:
+            for n, s in sorted(cls._poisoned):
+                acc = ((acc * _GOLD) ^ _pair_seed(2, n, s)) & _M64
+            for pair in sorted(set((int(n), int(s)) for n, s in pairs)):
+                rule = cls._rule_for(*pair)
+                rng = Random(_pair_seed(cls.seed, *pair))
+                for _ in range(k):
+                    eio, enospc, delay, torn = cls._decide(rule, rng)
+                    word = ((int(eio) << 63) ^ (int(enospc) << 62)
+                            ^ (int(torn > 0.0) << 61)
+                            ^ int(delay * 1e9))
+                    acc = ((acc * _GOLD) ^ word) & _M64
+        return f"{acc:016x}"
+
+    # -- observability ------------------------------------------------------
+
+    @classmethod
+    def snapshot(cls) -> dict:
+        """The ``/storage`` JSON view: config + injected counters."""
+        with cls._lock:
+            def k(s):
+                return "*" if s is None else s
+            return {
+                "enabled": cls.enabled,
+                "seed": cls.seed,
+                "rules": {f"{k(n)}/{k(s)}": r.asdict()
+                          for (n, s), r in sorted(
+                              cls._rules.items(),
+                              key=lambda it: (str(it[0][0]),
+                                              str(it[0][1])))},
+                "poisoned": sorted(f"{n}/{s}"
+                                   for n, s in cls._poisoned),
+                "injected": {
+                    "fsync_eio": cls.n_fsync_eio,
+                    "enospc": cls.n_enospc,
+                    "slow_fsync": cls.n_slow,
+                    "torn": cls.n_torn,
+                    "per_pair": {f"{n}/{s}": {
+                        "fsync_eio": v[0], "enospc": v[1],
+                        "slow_fsync": v[2], "torn": v[3]}
+                        for (n, s), v in sorted(cls._per_pair.items())},
+                },
+            }
+
+    # -- the /storage HTTP control routes ----------------------------------
+
+    @classmethod
+    def http_route(cls, path: str):
+        """GET routes for the statshttp listener (query-string verbs,
+        like ``/chaos``):
+
+        - ``/storage``                      -> state snapshot
+        - ``/storage/set?node=0&seg=1&fsync_eio=0.5&persist=1&``
+          ``enospc=0.1&fsync_delay_ms=5&fsync_jitter_ms=2&torn=0.01``
+          (omit node/seg = wildcard)
+        - ``/storage/clear``                -> remove everything, disable
+        - ``/storage/seed?v=123``           -> reseed (fresh streams)
+
+        Returns ``(status, content_type, body)`` or None (no match).
+        """
+        path, _, query = path.partition("?")
+        if path != "/storage" and not path.startswith("/storage/"):
+            return None
+        q = {k: v[-1] for k, v in parse_qs(query).items()}
+        verb = path[len("/storage"):].strip("/")
+        try:
+            if verb == "":
+                pass  # snapshot only
+            elif verb == "set":
+                cls.set_rule(
+                    int(q["node"]) if "node" in q else None,
+                    int(q["seg"]) if "seg" in q else None,
+                    fsync_eio_p=float(q.get("fsync_eio", 0)),
+                    fsync_persist=bool(int(q.get("persist", 0))),
+                    enospc_p=float(q.get("enospc", 0)),
+                    fsync_delay_s=float(q.get("fsync_delay_ms", 0))
+                    / 1e3,
+                    fsync_jitter_s=float(q.get("fsync_jitter_ms", 0))
+                    / 1e3,
+                    torn_p=float(q.get("torn", 0)))
+            elif verb == "clear":
+                cls.clear()
+            elif verb == "seed":
+                cls.configure(seed=int(q["v"]))
+            else:
+                return ("404 Not Found", "application/json",
+                        b'{"err":"no such storage verb"}')
+        except (KeyError, ValueError) as exc:
+            return ("400 Bad Request", "application/json",
+                    json.dumps({"err": str(exc)}).encode())
+        return ("200 OK", "application/json",
+                json.dumps(cls.snapshot()).encode())

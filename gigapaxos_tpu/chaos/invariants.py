@@ -190,6 +190,41 @@ async def churn_settled(peers: Dict[int, Tuple[str, int]],
             return False, errs
 
 
+async def storage_healthy(peers: Dict[int, Tuple[str, int]],
+                          allow_quarantine: bool = False,
+                          expect_rotation_on: Optional[int] = None
+                          ) -> List[str]:
+    """Storage-plane epilogue for fault drills (reads ``/stats`` ->
+    ``wal.health``, the operator surface): after the storm no live node
+    may still be DEGRADED (rotation was supposed to save it) or stuck
+    disk-full (emergency compaction was supposed to clear it).
+    ``allow_quarantine``: a corrupt-and-restart drill legitimately
+    leaves quarantined segment records behind — without it any
+    quarantine is a violation.  ``expect_rotation_on``: assert the
+    fsync-EIO victim actually rotated its segment handle at least once
+    (the drill bit; zero rotations means the fault never landed)."""
+    errs: List[str] = []
+    views = await scrape_cluster(peers, "/stats", timeout=5.0)
+    for node, v in sorted(views.items()):
+        if v is None:
+            errs.append(f"node {node}: /stats unreachable")
+            continue
+        h = (v.get("wal") or {}).get("health") or {}
+        if h.get("degraded"):
+            errs.append(f"node {node}: WAL still DEGRADED after the "
+                        "storm (rotation failed to restore service)")
+        if h.get("disk_full"):
+            errs.append(f"node {node}: WAL still disk-full after the "
+                        "storm (emergency compaction never cleared it)")
+        if h.get("quarantined") and not allow_quarantine:
+            errs.append(f"node {node}: unexpected quarantined WAL "
+                        f"segment(s): {h['quarantined']}")
+        if expect_rotation_on == node and not h.get("rotations"):
+            errs.append(f"node {node}: zero WAL rotations — the "
+                        "injected fsync failures never bit")
+    return errs
+
+
 def capture_on_violation(violations: List[str]) -> List[str]:
     """Flight-recorder hookup: when a scenario's invariant checks
     failed, snapshot every live node's black-box ring so the violating

@@ -23,6 +23,14 @@ whole run to :mod:`~gigapaxos_tpu.chaos.invariants`:
 - ``mini_partition_heal`` — 2-node partition-heal in <20s, the
   ``smoke``-gate version: a full partition stalls the 2-quorum, acked
   history survives, heal restores service.
+- ``disk_storm``          — the STORAGE fault plane's storm (real
+  fsync): transient fsync EIO mid-load (segment rotation saves the
+  group-commit buffer — ``no_lost_acks`` is the headline), a
+  disk-full window (status-5 sheds + emergency compaction), then a
+  kill + bit-flip a mid-file WAL record + restart (CRC quarantine +
+  catch-up re-convergence).
+- ``mini_disk_fault``     — 2-node 100%-fsync-EIO drill in seconds,
+  the ``smoke``-gate proof that rotation keeps every ack durable.
 
 Every scenario returns one JSON-able row (the ``CHAOS_*.json``
 artifact format rendered by ``render_perf.py``): staged timeline,
@@ -36,13 +44,15 @@ CLI: ``python -m gigapaxos_tpu.chaos`` (see ``__main__.py``).
 from __future__ import annotations
 
 import asyncio
+import glob
+import os
 import random
 import tempfile
 import time
 from typing import Dict, List, Optional, Tuple
 
 from gigapaxos_tpu.chaos import invariants as inv
-from gigapaxos_tpu.chaos.faults import ChaosPlane
+from gigapaxos_tpu.chaos.faults import ChaosPlane, StorageChaos
 from gigapaxos_tpu.paxos.client import PaxosClientAsync
 from gigapaxos_tpu.paxos.interfaces import CounterApp
 from gigapaxos_tpu.paxos.packets import group_key
@@ -80,11 +90,20 @@ class _Ctx:
         self.t_heal = self.t0
         self._pairs = [(s, d) for s in emu.addr_map
                        for d in emu.addr_map if s != d]
+        # (node, wal-segment) pairs for the STORAGE plane's share of
+        # the fingerprint; seg range is a fixed superset (the digest
+        # is pure, surplus pairs just fold deterministic words)
+        self._spairs = [(n, s) for n in emu.addr_map for s in range(4)]
         # running fold of the plane's schedule fingerprint at every
         # stage boundary: captures the WHOLE evolving fault schedule
         # (rules change mid-scenario; a heal clears partition edges),
         # identical across runs with the same seed
         self._sched_acc = 0
+        # storage epilogue expectations (set by storage scenarios):
+        # a corrupt-and-restart drill legitimately leaves quarantined
+        # segments; an EIO drill must show rotations on its victim
+        self.allow_quarantine = False
+        self.expect_rotation_on: Optional[int] = None
 
     def stage(self, event: str, heal: bool = False) -> None:
         t = time.monotonic()
@@ -95,6 +114,11 @@ class _Ctx:
         fp = int(ChaosPlane.schedule_fingerprint(self._pairs), 16)
         self._sched_acc = ((self._sched_acc * 0x9E3779B97F4A7C15)
                            ^ fp) & ((1 << 64) - 1)
+        # the storage plane's schedule is part of the SAME replay
+        # proof: fold its digest at every stage boundary too
+        sfp = int(StorageChaos.schedule_fingerprint(self._spairs), 16)
+        self._sched_acc = ((self._sched_acc * 0x9E3779B97F4A7C15)
+                           ^ sfp) & ((1 << 64) - 1)
         log.info("chaos stage +%.2fs: %s", t - self.t0, event)
 
     def schedule_fingerprint(self) -> str:
@@ -301,6 +325,91 @@ async def _sc_mini_partition_heal(ctx: _Ctx) -> None:
     await ctx.drive(2, 5)
 
 
+def _flip_one_record(nodedir: str) -> str:
+    """Bit-flip one mid-file WAL record under a dead node's log dir
+    (the offline half of the storage fault plane: post-crash media
+    corruption).  Prefers the fattest segment and a middle record;
+    returns a ``file#index@offset`` label for the stage log."""
+    from gigapaxos_tpu.paxos.logger import corrupt_wal_record
+    paths = sorted(glob.glob(os.path.join(nodedir, "wal-*.log")),
+                   key=os.path.getsize, reverse=True)
+    for p in paths:
+        for idx in (40, 20, 10, 5, 2, 1):
+            for field in ("payload", "crc", "header"):
+                try:
+                    off = corrupt_wal_record(p, idx, field)
+                except (IndexError, ValueError):
+                    continue
+                return f"{os.path.basename(p)}#{idx}@{off}"
+    raise AssertionError(f"no WAL record to corrupt under {nodedir}")
+
+
+async def _sc_disk_storm(ctx: _Ctx) -> None:
+    # the storage-plane storm (real fsync on): three acts — fsyncgate,
+    # disk full, post-crash corruption — under the SAME invariants as
+    # the network storms.  no_lost_acks over act one is the headline:
+    # an fsync failure mid-group-commit must never lose an acked op.
+    victim, victim2 = 1, 2
+    await ctx.drive(3, 8)
+    # act 1 — transient fsync EIO mid-load: the failed handle is
+    # poisoned (fsyncgate: never retry fsync on the same fd), the lane
+    # rotates to a fresh wal-<k>.<gen>.log and re-appends the un-acked
+    # group-commit buffer BEFORE acking
+    StorageChaos.set_rule(victim, None, fsync_eio_p=0.35)
+    ctx.stage(f"storage: 35% transient fsync EIO on node {victim}")
+    await ctx.drive(3, 12)
+    StorageChaos.set_rule(victim, None)  # all-zero rule = removed
+    ctx.stage("storage: fsync EIO cleared", heal=True)
+    await ctx.drive(2, 6)
+    # act 2 — disk full: every append on the victim ENOSPCs; it sheds
+    # new proposals with status 5 (clients rotate away) and arms the
+    # emergency compaction, while quorums form on the other two nodes
+    StorageChaos.set_rule(victim, None, enospc_p=1.0)
+    ctx.stage(f"storage: disk full (ENOSPC) on node {victim}")
+    await ctx.drive(2, 6, timeout=_scale(2.5))
+    StorageChaos.set_rule(victim, None)
+    ctx.stage("storage: space reclaimed", heal=True)
+    await ctx.drive(2, 6)
+    from gigapaxos_tpu.net.cluster import scrape_cluster
+    views = await scrape_cluster({victim: ctx.peers()[victim]},
+                                 "/stats", timeout=5.0)
+    shed = int(((views.get(victim) or {}).get("counters") or {})
+               .get("shed_disk", 0))
+    if shed == 0:
+        raise AssertionError(
+            "disk-full window shed nothing — the status-5 path never "
+            "fired on the ENOSPC victim")
+    # act 3 — post-crash corruption: kill a node, flip one byte in a
+    # mid-file WAL record, restart.  Recovery must quarantine the
+    # segment FROM that record (keep the verified prefix), surface it
+    # in wal.health, and re-converge via catch-up from the peers.
+    ctx.emu.kill(victim2)
+    ctx.stage(f"crash-stop node {victim2} for offline corruption")
+    flipped = _flip_one_record(f"{ctx.emu.logdir}/n{victim2}")
+    ctx.emu.restart(victim2)
+    ctx.stage(f"restart node {victim2} with a bit-flipped WAL record "
+              f"({flipped}) — quarantine + catch-up", heal=True)
+    ctx.allow_quarantine = True
+    ctx.expect_rotation_on = victim
+    await ctx.drive(2, 8)
+
+
+async def _sc_mini_disk_fault(ctx: _Ctx) -> None:
+    # smoke-gate EIO drill: 100% transient fsync EIO on node 0 under
+    # load — every group commit must rotate and re-append before
+    # acking.  Proves the fault BITES (rotations observed on the
+    # victim, asserted in the storage epilogue) and that no ack is
+    # lost, in seconds.
+    await ctx.drive(2, 4)
+    StorageChaos.set_rule(0, None, fsync_eio_p=1.0)
+    ctx.stage("storage: 100% transient fsync EIO on node 0")
+    await ctx.drive(2, 5)
+    StorageChaos.set_rule(0, None)
+    ctx.stage("storage: cleared", heal=True)
+    await ctx.drive(2, 4)
+    ctx.expect_rotation_on = 0
+
+
 # name -> (timeline fn, cluster spec)
 SCENARIOS: Dict[str, dict] = {
     "partition_heal": {
@@ -321,6 +430,12 @@ SCENARIOS: Dict[str, dict] = {
     "mini_partition_heal": {
         "fn": _sc_mini_partition_heal, "n_nodes": 2, "n_groups": 4,
         "backend": "native", "sync_wal": False},
+    "disk_storm": {
+        "fn": _sc_disk_storm, "n_nodes": 3, "n_groups": 9,
+        "backend": "native", "sync_wal": True},
+    "mini_disk_fault": {
+        "fn": _sc_mini_disk_fault, "n_nodes": 2, "n_groups": 4,
+        "backend": "native", "sync_wal": True},
 }
 
 
@@ -348,6 +463,8 @@ def run_scenario(name: str, seed: int = 1,
     try:
         ChaosPlane.reset()
         ChaosPlane.configure(seed=seed, enabled=True)
+        StorageChaos.reset()
+        StorageChaos.configure(seed=seed, enabled=True)
         Config.set(PC.STATS_PORT, 0)  # every node scrapeable
         #                 (invariants read /groups + /stats over HTTP)
         if shards0:
@@ -382,15 +499,19 @@ def run_scenario(name: str, seed: int = 1,
             for g, recs in sorted(ctx.hist.items()):
                 errs_ord += [f"group {g}: {e}"
                              for e in inv.check_single_order(recs)]
+            errs_sto = await inv.storage_healthy(
+                peers, allow_quarantine=ctx.allow_quarantine,
+                expect_rotation_on=ctx.expect_rotation_on)
             return {
                 "invariants": {
                     "no_lost_acks": not errs_acks,
                     "digest_linearizable": not (errs_dig or errs_ord),
                     "cursors_converged": ok_cur,
                     "churn_steady": ok_churn,
+                    "storage_healthy": not errs_sto,
                 },
                 "violations": (errs_acks + errs_dig + errs_ord
-                               + errs_cur + errs_churn)[:20],
+                               + errs_cur + errs_churn + errs_sto)[:20],
                 "recovery_s": round(recovery_s, 3),
                 "schedule_fingerprint": ctx.schedule_fingerprint(),
             }
@@ -407,17 +528,20 @@ def run_scenario(name: str, seed: int = 1,
                 row["blackbox"] = paths
     finally:
         snap = ChaosPlane.snapshot()
+        ssnap = StorageChaos.snapshot()
         try:
             if emu is not None:
                 emu.stop()
         finally:
             ChaosPlane.reset()
+            StorageChaos.reset()
             Config.unset(PC.STATS_PORT)
             Config.set(PC.ENGINE_SHARDS, prior_shards)
     if shards0:
         row["engine_shards_timeline"] = [shards0] + ctx.shard_timeline
     row["stages"] = ctx.stages
     row["faults"] = snap["injected"]
+    row["storage_faults"] = ssnap["injected"]
     row["acked"] = sum(len(v) for v in ctx.hist.values())
     row["client_errors"] = ctx.client_errors
     row["wall_s"] = round(time.monotonic() - t_wall, 3)

@@ -147,6 +147,9 @@ def _load() -> Optional[ctypes.CDLL]:
         lib.gp_encode_wal.restype = i64
         lib.gp_encode_wal.argtypes = [i64, u8p, u64p, i32p, i32p, u64p,
                                       i64p, u8p, u8p, i64]
+        lib.gp_encode_wal_crc.restype = i64
+        lib.gp_encode_wal_crc.argtypes = [i64, u8p, u64p, i32p, i32p,
+                                          u64p, i64p, u8p, u8p, i64]
         dbl, dblp = ctypes.c_double, ctypes.c_void_p
         lib.gp_gs_handle_accepts.restype = i64
         lib.gp_gs_handle_accepts.argtypes = [
@@ -456,9 +459,12 @@ def have_native() -> bool:
 
 def encode_wal(rtype: np.ndarray, gkey: np.ndarray, slot: np.ndarray,
                bal: np.ndarray, req: np.ndarray,
-               payloads: Sequence[bytes]) -> bytes:
+               payloads: Sequence[bytes], crc: bool = False) -> bytes:
     """Encode n WAL records into one contiguous buffer in the logger's
-    ``_REC`` layout — ONE C call instead of a struct.pack per record."""
+    ``_REC`` layout — ONE C call instead of a struct.pack per record.
+    ``crc=True`` emits the v2 frame (PC.WAL_CRC): a trailing zlib-CRC32
+    over header+payload per record; callers pass ``logger.wal_crc`` so
+    the buffer matches the segment files' version."""
     n = len(rtype)
     lib = _load()
     pay_off = np.zeros(n + 1, np.int64)
@@ -472,9 +478,10 @@ def encode_wal(rtype: np.ndarray, gkey: np.ndarray, slot: np.ndarray,
         req = np.ascontiguousarray(req, np.uint64)
         pay = np.frombuffer(b"".join(payloads), np.uint8) if pay_off[n] \
             else np.empty(1, np.uint8)
-        cap = int(pay_off[n]) + n * 29
+        cap = int(pay_off[n]) + n * (33 if crc else 29)
         out = np.empty(cap, np.uint8)
-        w = lib.gp_encode_wal(
+        fn = lib.gp_encode_wal_crc if crc else lib.gp_encode_wal
+        w = fn(
             n, _p(rtype, ctypes.c_uint8), _p(gkey, ctypes.c_uint64),
             _p(slot, ctypes.c_int32), _p(bal, ctypes.c_int32),
             _p(req, ctypes.c_uint64), _p(pay_off, ctypes.c_int64),
@@ -484,14 +491,22 @@ def encode_wal(rtype: np.ndarray, gkey: np.ndarray, slot: np.ndarray,
         return out[:w].tobytes()
     # fallback (logger._REC layout)
     import struct
+    import zlib
     rec = struct.Struct("<BQiiQI")
+    crc_s = struct.Struct("<I")
     parts = []
     for i in range(n):
         p = payloads[i] if payloads else b""
-        parts.append(rec.pack(int(rtype[i]), int(gkey[i]), int(slot[i]),
-                              int(bal[i]), int(req[i]), len(p)))
-        if p:
-            parts.append(p)
+        hdr = rec.pack(int(rtype[i]), int(gkey[i]), int(slot[i]),
+                       int(bal[i]), int(req[i]), len(p))
+        if crc:
+            body = hdr + p
+            parts.append(body)
+            parts.append(crc_s.pack(zlib.crc32(body)))
+        else:
+            parts.append(hdr)
+            if p:
+                parts.append(p)
     return b"".join(parts)
 
 

@@ -98,6 +98,12 @@ def observability_routes(path: str, groups_fn: Optional[Callable] = None,
         from gigapaxos_tpu.chaos.faults import ChaosPlane
         return ChaosPlane.http_route(
             path + (("?" + query) if query else ""))
+    if path == "/storage" or path.startswith("/storage/"):
+        # the storage fault plane (StorageChaos) — same verb shape as
+        # /chaos: /storage, /storage/set?..., /storage/clear, /storage/seed
+        from gigapaxos_tpu.chaos.faults import StorageChaos
+        return StorageChaos.http_route(
+            path + (("?" + query) if query else ""))
     return None
 
 
@@ -108,10 +114,16 @@ class StatsListener:
 
     def __init__(self, metrics_fn: Callable[[], dict],
                  listen: Tuple[str, int] = ("127.0.0.1", 0),
-                 extra_routes: Optional[Callable] = None):
+                 extra_routes: Optional[Callable] = None,
+                 health_fn: Optional[Callable[[], Optional[str]]] = None):
         self.metrics_fn = metrics_fn
         self.listen = listen
         self.extra_routes = extra_routes
+        # health_fn() -> None (healthy) | short reason string (impaired);
+        # flips /healthz to 503 so orchestrators stop routing new work
+        # to a node that can no longer make proposals durable, while
+        # /stats and /metrics keep answering (it still serves commits)
+        self.health_fn = health_fn
         self._server: Optional[asyncio.base_events.Server] = None
 
     async def start(self) -> None:
@@ -155,6 +167,16 @@ class StatsListener:
         if method != "GET":
             return "405 Method Not Allowed", "text/plain", b"GET only\n"
         if path == "/healthz":
+            why = None
+            if self.health_fn is not None:
+                try:
+                    why = self.health_fn()
+                except Exception:
+                    log.exception("health probe failed")
+                    why = "health probe failed"
+            if why is not None:
+                return ("503 Service Unavailable", "text/plain",
+                        f"unhealthy: {why}\n".encode())
             return "200 OK", "text/plain", b"ok\n"
         try:
             resp = metrics_response(path, self.metrics_fn)

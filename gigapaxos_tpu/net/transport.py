@@ -26,6 +26,7 @@ kernel worker thread uses that.
 from __future__ import annotations
 
 import asyncio
+import random
 import ssl as ssl_mod
 import struct
 import threading
@@ -509,7 +510,12 @@ class Transport:
                     host, port, ssl=self.ssl_client)
             except OSError:
                 self.connect_failures += 1
-                await asyncio.sleep(backoff)
+                # jittered exponential backoff: N writers reconnecting
+                # to a restarted peer on the bare doubling schedule stay
+                # phase-locked (every node lost the link in the same
+                # instant), hammering it in synchronized waves — spread
+                # each wait uniformly over [0.5x, 1.5x]
+                await asyncio.sleep(backoff * (0.5 + random.random()))
                 backoff = min(backoff * 2, 2.0)
                 continue
             backoff = self.reconnect_base_s

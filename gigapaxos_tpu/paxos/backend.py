@@ -142,6 +142,18 @@ class AcceptorBackend(abc.ABC):
         they can gather many rows in one device round trip."""
         return [self.snapshot_row(int(r)) for r in rows]
 
+    @staticmethod
+    def gate_acks(res: AcceptRes) -> AcceptRes:
+        """Withdraw every ack in an accept result: the durability
+        barrier AFTER the engine call failed (WAL impaired), so the
+        on-device votes must not be reported to any coordinator — a
+        quorum counting a non-fsynced vote breaks no_lost_acks.  The
+        replies go out nacked at the acceptor's current ballot (the
+        coordinator simply never counts this acceptor; the vote stays
+        inert on-device and is re-persisted if the slot is re-driven).
+        Pure SPI-surface helper: no backend state is touched."""
+        return res._replace(acked=np.zeros_like(np.asarray(res.acked)))
+
     def inspect_rows(self, rows) -> Dict[str, np.ndarray]:
         """Device-truth consensus cursors for the introspection plane
         (``GET /groups``): promised ballot, coordinator ballot, next

@@ -181,6 +181,14 @@ class PaxosClientAsync:
                     # from the response cache), so surface it
                     self._preferred = idx
                     return resp
+                if resp.status == 5 and self._preferred == idx:
+                    # disk-full / WAL-degraded shed: this server cannot
+                    # make anything durable right now.  The per-attempt
+                    # rotation below retries elsewhere; ALSO demote it
+                    # as the preferred server so the next request
+                    # starts elsewhere instead of re-discovering the
+                    # shed on its first attempt
+                    self._preferred = (idx + 1) % len(self.servers)
                 last_exc = RuntimeError(f"status={resp.status}")
                 # non-ok statuses are immediate (no wait): back off a
                 # beat so a re-electing group isn't hammered

@@ -49,7 +49,8 @@ from gigapaxos_tpu.paxos.backend import (AcceptorBackend, ColumnarBackend,
 from gigapaxos_tpu.paxos.grouptable import GroupTable
 from gigapaxos_tpu.paxos.interfaces import Replicable
 from gigapaxos_tpu.paxos.logger import (CheckpointRec, LogEntry, PaxosLogger,
-                                        REC_ACCEPT, REC_DECIDE)
+                                        REC_ACCEPT, REC_DECIDE,
+                                        WalDegradedError, WalImpairedError)
 from gigapaxos_tpu.paxos.paxosconfig import PC
 from gigapaxos_tpu.utils.config import Config
 from gigapaxos_tpu.utils.instrument import RequestInstrumenter
@@ -350,7 +351,12 @@ class PaxosNode:
         self.logger = PaxosLogger(
             logdir, sync=bool(Config.get(PC.SYNC_WAL)),
             compact_threshold_bytes=int(Config.get(PC.WAL_COMPACT_BYTES)),
-            segments=self.shards)
+            segments=self.shards, node_id=node_id,
+            wal_crc=bool(Config.get(PC.WAL_CRC)))
+        # frame version every encode_wal call must emit (v2 = trailing
+        # per-record CRC32) — read once; the logger normalized its
+        # segment files to this version at construction
+        self._wal_crc = self.logger.wal_crc
         self.batch_size = int(Config.get(PC.BATCH_SIZE))
         self.batch_timeout = float(Config.get(PC.BATCH_TIMEOUT_S))
         self.batch_coalesce = float(Config.get(PC.BATCH_COALESCE_S))
@@ -503,8 +509,11 @@ class PaxosNode:
         # chaos fault plane (PC.CHAOS_*, all defaults off): only-enable
         # like the tracing knobs — a plane configured programmatically
         # (scenario runner, /chaos route) survives node constructions
-        from gigapaxos_tpu.chaos.faults import ChaosPlane
+        from gigapaxos_tpu.chaos.faults import ChaosPlane, StorageChaos
         ChaosPlane.configure_from_pc()
+        # the disk sibling (PC.STORAGE_CHAOS_*): same only-enable boot
+        # mirror; the logger's IO shim consults it per append/fsync
+        StorageChaos.configure_from_pc()
         # stashed for the flight recorder's wave hook (chaos fault
         # verdicts ride the W records when the plane is on)
         self._chaos = ChaosPlane
@@ -598,6 +607,11 @@ class PaxosNode:
         self.n_park_dropped = 0   # parked proposals dropped at cap
         self.n_redrive_capped = 0  # re-drive ticks that hit the 256 cap
         self.n_installs = 0       # coordinator installs won (failover)
+        self.n_shed_disk = 0      # proposals shed status 5 (WAL impaired)
+        self.n_wal_nacked = 0     # accepts nacked because WAL failed
+        # one-shot latch so the degraded-mode blackbox trigger and log
+        # line fire once, not per batch (worker threads, _stat_lock)
+        self._degraded_seen = False
         # ballot churn (consensus-health introspection; PAPERS
         # 2006.01885 motivates surfacing leader/ballot churn as a
         # first-class signal): bumped wherever this node adopts a NEW
@@ -683,6 +697,40 @@ class PaxosNode:
         single-lane nodes and non-lane threads)."""
         return getattr(self._wtls, "wal_seg", 0)
 
+    def _note_wal_impaired(self, exc: WalImpairedError, n: int) -> None:
+        """Bookkeeping for an accept batch whose WAL barrier failed:
+        count the withdrawn acks, and on the FIRST entry into degraded
+        mode fire the blackbox trigger + one error log (the logger's
+        degraded flag is sticky until restart, so this fires once)."""
+        first = False
+        with self._stat_lock:
+            self.n_wal_nacked += n
+            if isinstance(exc, WalDegradedError) and \
+                    not self._degraded_seen:
+                self._degraded_seen = first = True
+        if first:
+            log.error(
+                "node %d WAL DEGRADED (%s): accepts nacked and new "
+                "proposals shed (status 5) until restart; commits keep "
+                "executing and reads keep serving", self.id, exc)
+            bb = self.blackbox
+            if bb is not None:
+                bb.trigger("wal_degraded")
+
+    def _log_decides(self, gkeys, slots, reqs) -> None:
+        """Decision WAL append.  Async (fsync=False) AND impairment-
+        tolerant: decisions are recoverable from peers, so replies never
+        gate on this record and a full/degraded WAL must not stop the
+        learner — commits keep executing, recovery re-syncs from peers."""
+        try:
+            self.logger.log_raw_inline(native.encode_wal(
+                np.full(len(slots), REC_DECIDE, np.uint8), gkeys, slots,
+                np.zeros(len(slots), np.int32), reqs, [],
+                crc=self._wal_crc), fsync=False, n_entries=len(slots),
+                seg=self._wal_seg())
+        except WalImpairedError:
+            pass  # peers hold the decisions; keep learning
+
     def _now(self) -> float:
         """The engine clock: every time-driven consensus decision
         (redrive, election backoff, failure detection, parked/idle
@@ -731,7 +779,8 @@ class PaxosNode:
                 try:
                     self.stats_http = StatsListener(
                         self.metrics, ("127.0.0.1", sport),
-                        extra_routes=self._obs_route)
+                        extra_routes=self._obs_route,
+                        health_fn=self.logger.impaired)
                     self._loop.run_until_complete(
                         self.stats_http.start())
                 except OSError as exc:
@@ -1968,7 +2017,11 @@ class PaxosNode:
         # is re-emitted to every member — a lost Accept otherwise stalls
         # its slot forever (and every later one: execution is in-order),
         # while client retransmits die on the _proposed dedupe.
-        if self._proposed:
+        # Gated while the WAL is impaired: a re-drive would resurrect
+        # accepts whose self vote never became durable (the batch whose
+        # emits were skipped at the failed barrier) — the slots stay
+        # parked until rotation recovers or the node restarts.
+        if self._proposed and self.logger.impaired() is None:
             n_redriven = 0
             for req_id, fl in list(self._proposed.items()):
                 if S > 1 and fl.row % S != shard:
@@ -2449,6 +2502,8 @@ class PaxosNode:
                 "parked": self.n_parked,
                 "park_dropped": self.n_park_dropped,
                 "shed": self.n_shed,
+                "shed_disk": self.n_shed_disk,
+                "wal_nacked": self.n_wal_nacked,
                 "installs": self.n_installs,
                 "ballot_changes": self.n_ballot_changes,
                 "groups": len(self.table),
@@ -2488,7 +2543,8 @@ class PaxosNode:
             # run every few seconds against a million-group node —
             # asks for the cheap counters-only view
             out["groups_health"] = self._groups_health()
-            out["wal"] = {"segments": self.logger.segment_stats()}
+            out["wal"] = {"segments": self.logger.segment_stats(),
+                          "health": self.logger.wal_health()}
             out["profiler"] = DelayProfiler.snapshot()
             out["spans"] = RequestInstrumenter.span_stats()
             slow = RequestInstrumenter.slow_traces()
@@ -2701,6 +2757,32 @@ class PaxosNode:
         """Host half of the request path BEFORE the engine call: shed,
         dedupe, forward/park, lane assembly (split out for the fused
         coordinator wave)."""
+        # storage degraded / disk full: shed ALL fresh proposals with
+        # status 5 — the disk-full shed, distinct from the status-1
+        # congestion retry so clients back off AND rotate to another
+        # server rather than hammer a node that cannot make anything
+        # durable.  Forwarded props are answered to their entry
+        # replica, which relays the status to the waiting client (see
+        # the Response handler).  Commits/decides are NOT handled here
+        # and still flow: a degraded node keeps learning and serving.
+        if (reqs or soas or props) and self.logger.impaired() is not None:
+            n = 0
+            for sb in soas:
+                for i in range(len(sb.req_id)):
+                    self._route(int(sb.sender[i]), pkt.Response(
+                        self.id, int(sb.gkey[i]), int(sb.req_id[i]),
+                        5, b""))
+                n += len(sb.req_id)
+            for o in reqs:
+                self._route(o.sender, pkt.Response(
+                    self.id, o.gkey, o.req_id, 5, b""))
+            for o in props:
+                self._route(o.sender, pkt.Response(
+                    self.id, o.gkey, o.req_id, 5, b""))
+            n += len(reqs) + len(props)
+            with self._stat_lock:
+                self.n_shed_disk += n
+            return
         # congestion-collapse guard (PC.INTAKE_BACKLOG_LIMIT): a deep
         # inbound backlog means the engine is past its knee.  Shed a
         # PROPORTIONAL share of fresh client work (RED-style: ramps from
@@ -3006,22 +3088,31 @@ class PaxosNode:
                 if meta is not None and unpack_ballot(
                         int(self._bal[row]))[1] == self.id:
                     self._start_election(row, meta)
+        wal_ok = True
         if self_acked is not None:
-            self._after_propose_self(rows, req_ids, flag_parts,
-                                     pay_parts, res, self_acked,
-                                     self_newly, self_pre, self_cur,
-                                     now)
-        self._emit_accepts(rows, req_ids, flag_parts, pay_parts, res,
-                           skip_self=self_acked is not None)
+            wal_ok = self._after_propose_self(rows, req_ids, flag_parts,
+                                              pay_parts, res, self_acked,
+                                              self_newly, self_pre,
+                                              self_cur, now)
+        if wal_ok:
+            self._emit_accepts(rows, req_ids, flag_parts, pay_parts, res,
+                               skip_self=self_acked is not None)
 
     def _after_propose_self(self, rows, req_ids, flags, payloads, res,
                             self_acked, self_newly, self_pre, self_cur,
-                            now) -> None:
+                            now) -> bool:
         """Host bookkeeping for the fused self-accept/vote: everything
         the loopback self-wave (_handle_accepts + _handle_accept_replies
         on our own frames) used to do — WAL durability BEFORE anything
         leaves this batch, acceptor mirrors, preemption adoption, and
-        commits for single-member quorums."""
+        commits for single-member quorums.
+
+        Returns False when the WAL barrier failed: the self vote is
+        already counted on-device but is NOT durable, so nothing from
+        this batch (accepts, single-member commits) may leave the node
+        — a quorum formed on an erasable vote would break no_lost_acks.
+        The caller skips _emit_accepts; clients retry elsewhere."""
+        wal_ok = True
         ai = np.flatnonzero(self_acked)
         if len(ai):
             arows = rows[ai]
@@ -3035,13 +3126,17 @@ class PaxosNode:
             wal_buf = native.encode_wal(
                 np.full(len(ai), REC_ACCEPT, np.uint8),
                 self._row_gkey[arows], slots_g, cbals, req_ids[ai],
-                blobs)
+                blobs, crc=self._wal_crc)
             # durability barrier: the self vote counts toward quorums,
             # so it must be durable before any resulting decision (or
             # remote accept) leaves this batch
-            self.logger.log_raw_inline(wal_buf, n_entries=len(ai),
-                                       seg=self._wal_seg())
-            if RequestInstrumenter.enabled:
+            try:
+                self.logger.log_raw_inline(wal_buf, n_entries=len(ai),
+                                           seg=self._wal_seg())
+            except WalImpairedError as exc:
+                self._note_wal_impaired(exc, len(ai))
+                wal_ok = False
+            if wal_ok and RequestInstrumenter.enabled:
                 ai_l = ai.tolist()
                 farr = np.fromiter((flags[i] for i in ai_l), np.int64,
                                    len(ai_l))
@@ -3064,7 +3159,7 @@ class PaxosNode:
                 self._note_ballot_change(np.unique(rp[gain]))
             np.maximum.at(self._bal, rp, cp)
         ni = np.flatnonzero(self_newly)
-        if len(ni):
+        if len(ni) and wal_ok:
             # single-member quorum: decided on our own vote
             with self._stat_lock:
                 self.n_decided += len(ni)
@@ -3075,6 +3170,7 @@ class PaxosNode:
                 np.asarray(res.slot)[ni].astype(np.int32),
                 np.asarray(res.cbal)[ni].astype(np.int32),
                 *_split_reqs(reqs))
+        return wal_ok
 
     def _emit_commits(self, nrows, gkeys, slots, bals, rlo, rhi,
                       skip_self: bool = False) -> None:
@@ -3187,31 +3283,42 @@ class PaxosNode:
                 blobs.append(blob if blob else b"\x00")
             wal_buf = native.encode_wal(
                 np.full(len(ai), REC_ACCEPT, np.uint8), gkeys[ai],
-                slots_all[ai], bals_all[ai], reqs_all[ai], blobs) \
+                slots_all[ai], bals_all[ai], reqs_all[ai], blobs,
+                crc=self._wal_crc) \
                 if len(ai) else None
             in_reply = keep & ~ow_m
             acked_u8 = acked_m.astype(np.uint8)
+            if wal_buf is not None:
+                # durability barrier: fsync before replies leave.  If
+                # the WAL is impaired the votes are withdrawn — replies
+                # go out nacked at the same ballot (the coordinator
+                # just never counts us; quorum forms elsewhere) since
+                # the on-device vote is not durable.
+                try:
+                    self.logger.log_raw_inline(wal_buf,
+                                               n_entries=len(ai),
+                                               seg=self._wal_seg())
+                except WalImpairedError as exc:
+                    self._note_wal_impaired(exc, len(ai))
+                    acked_u8[:] = 0
+                else:
+                    if RequestInstrumenter.enabled:
+                        ai_l = ai.tolist()
+                        farr = np.fromiter(
+                            (b[0] for b in blobs), np.int64, len(blobs))
+                        for k in np.flatnonzero(
+                                RequestInstrumenter.sampled_mask(
+                                    reqs_all[ai])
+                                | ((farr & FLAG_SAMPLED) != 0)).tolist():
+                            RequestInstrumenter.record(
+                                int(reqs_all[ai_l[k]]), "acc", self.id,
+                                force=True)
             out = []
             for dst in np.unique(send_all[in_reply]):
                 m = in_reply & (send_all == dst)
                 out.append((int(dst), pkt.AcceptReplyBatch(
                     self.id, gkeys[m], slots_all[m], reply_bal[m],
                     acked_u8[m])))
-            if wal_buf is not None:
-                # durability barrier: fsync before replies leave
-                self.logger.log_raw_inline(wal_buf, n_entries=len(ai),
-                                       seg=self._wal_seg())
-                if RequestInstrumenter.enabled:
-                    ai_l = ai.tolist()
-                    farr = np.fromiter(
-                        (b[0] for b in blobs), np.int64, len(blobs))
-                    for k in np.flatnonzero(
-                            RequestInstrumenter.sampled_mask(
-                                reqs_all[ai])
-                            | ((farr & FLAG_SAMPLED) != 0)).tolist():
-                        RequestInstrumenter.record(
-                            int(reqs_all[ai_l[k]]), "acc", self.id,
-                            force=True)
             for dst, arb in out:
                 self._route(dst, arb)
             return
@@ -3269,35 +3376,44 @@ class PaxosNode:
         if len(ai):
             wal_buf = native.encode_wal(
                 np.full(len(ai), REC_ACCEPT, np.uint8), gkeys[idxs[ai]],
-                slots[ai], bals[ai], req_ids[ai], blobs)
+                slots[ai], bals[ai], req_ids[ai], blobs,
+                crc=self._wal_crc)
 
         # group replies per coordinator sender (vectorized per dst)
         in_reply = ~np.asarray(res.out_window)
         reply_bal = np.where(acked, bals, np.asarray(res.cur_bal))
         acked_u8 = acked.astype(np.uint8)
         reply_gkeys = gkeys[idxs]
+        if wal_buf is not None:
+            # the send barrier: nothing acked leaves before durability.
+            # Impaired WAL ⇒ acks withdrawn (nack at the same ballot);
+            # the non-durable on-device votes stay inert.
+            try:
+                self.logger.log_raw_inline(wal_buf, n_entries=len(ai),
+                                           seg=self._wal_seg())
+            except WalImpairedError as exc:
+                self._note_wal_impaired(exc, len(ai))
+                res = self.backend.gate_acks(res)
+                acked_u8 = np.asarray(res.acked).astype(np.uint8)
+            else:
+                if RequestInstrumenter.enabled:
+                    # acc = accept fsync-durable at this acceptor (the
+                    # arrival stamp the coordinator's acc.tx pairs with)
+                    ai_l = ai.tolist()
+                    farr = np.fromiter((b[0] for b in blobs), np.int64,
+                                       len(blobs))
+                    for k in np.flatnonzero(
+                            RequestInstrumenter.sampled_mask(req_ids[ai])
+                            | ((farr & FLAG_SAMPLED) != 0)).tolist():
+                        RequestInstrumenter.record(
+                            int(req_ids[ai_l[k]]), "acc", self.id,
+                            force=True)
         out = []
         for dst in np.unique(senders[in_reply]):
             m = in_reply & (senders == dst)
             out.append((int(dst), pkt.AcceptReplyBatch(
                 self.id, reply_gkeys[m], slots[m],
                 reply_bal[m].astype(np.int32), acked_u8[m])))
-        if wal_buf is not None:
-            # the send barrier: nothing acked leaves before durability
-            self.logger.log_raw_inline(wal_buf, n_entries=len(ai),
-                                       seg=self._wal_seg())
-            if RequestInstrumenter.enabled:
-                # acc = accept fsync-durable at this acceptor (the
-                # arrival stamp the coordinator's acc.tx pairs with)
-                ai_l = ai.tolist()
-                farr = np.fromiter((b[0] for b in blobs), np.int64,
-                                   len(blobs))
-                for k in np.flatnonzero(
-                        RequestInstrumenter.sampled_mask(req_ids[ai])
-                        | ((farr & FLAG_SAMPLED) != 0)).tolist():
-                    RequestInstrumenter.record(
-                        int(req_ids[ai_l[k]]), "acc", self.id,
-                        force=True)
         for dst, arb in out:
             self._route(dst, arb)
 
@@ -3549,10 +3665,7 @@ class PaxosNode:
             return
         reqs = _merge_req(np.asarray(res.req_lo), np.asarray(res.req_hi))
         self._la[rows[ii]] = self._now()
-        self.logger.log_raw_inline(native.encode_wal(
-            np.full(len(ii), REC_DECIDE, np.uint8), gkeys[ii],
-            slots[ii], np.zeros(len(ii), np.int32), reqs[ii], []),
-            fsync=False, n_entries=len(ii), seg=self._wal_seg())
+        self._log_decides(gkeys[ii], slots[ii], reqs[ii])
         dec = self._dec
         for i in ii.tolist():
             dec.setdefault(int(rows[i]), {})[int(slots[i])] = \
@@ -3584,12 +3697,8 @@ class PaxosNode:
             if applied.any():
                 # decisions need not block on fsync (replies gate on the
                 # ACCEPT records; decisions are recoverable from peers)
-                self.logger.log_raw_inline(native.encode_wal(
-                    np.full(int(applied.sum()), REC_DECIDE, np.uint8),
-                    gkeys[applied], slots[applied],
-                    np.zeros(int(applied.sum()), np.int32),
-                    req_ids[applied], []), fsync=False,
-                    n_entries=int(applied.sum()), seg=self._wal_seg())
+                self._log_decides(gkeys[applied], slots[applied],
+                                  req_ids[applied])
             dec = self._dec
             for i in range(len(ex_rows)):
                 dec.setdefault(int(ex_rows[i]), {})[int(ex_slots[i])] = \
@@ -3630,12 +3739,8 @@ class PaxosNode:
         in-order execute, gap sync."""
         applied = np.asarray(res.applied)
         if applied.any():
-            self.logger.log_raw_inline(native.encode_wal(
-                np.full(int(applied.sum()), REC_DECIDE, np.uint8),
-                gkeys[sel][applied], slots_s[applied],
-                np.zeros(int(applied.sum()), np.int32), reqs_s[applied],
-                []), fsync=False, n_entries=int(applied.sum()),
-                seg=self._wal_seg())
+            self._log_decides(gkeys[sel][applied], slots_s[applied],
+                              reqs_s[applied])
         install = applied | np.asarray(res.stale)
         for i in np.flatnonzero(install):
             self._dec.setdefault(int(rows_s[i]), {})[int(slots_s[i])] = \

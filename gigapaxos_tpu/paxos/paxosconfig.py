@@ -202,6 +202,38 @@ class PC(ConfigKey):
     # zero-copy SoA receive: deliver each read chunk as ONE WireChunk
     # (blob + offset/type columns) instead of per-frame bytes slices
     WIRE_SOA_RX = True
+    # per-record CRC32 framing in the WAL (v2 frame): every appended
+    # record carries a trailing checksum over header+payload, and new
+    # segment files open with a GPW2 magic header.  Version-gated:
+    # headerless (pre-CRC) segments replay with the old torn-tail-only
+    # semantics.  A mid-segment mismatch on a v2 segment QUARANTINES
+    # the segment from that record on (surfaced in /stats wal health;
+    # checkpoint transfer re-syncs the affected groups) instead of
+    # silently replaying garbage.  Read once at node boot.
+    WAL_CRC = True
+    # storage fault plane (chaos/faults.py StorageChaos): deterministic
+    # fault injection on the WAL/checkpoint IO path — the disk sibling
+    # of the CHAOS_* link rules, per-(node, segment) with the same
+    # seeded golden-ratio replayability.  ALL defaults off; disabled
+    # costs the fsync path one attribute check.  Runtime control:
+    # GET /storage[...] on the stats listener.
+    STORAGE_CHAOS_SEED = 0
+    # probability an fsync on a WAL segment fails with EIO (0..1)
+    STORAGE_CHAOS_FSYNC_EIO = 0.0
+    # persistent mode: once a (node, seg) fsync fails, EVERY later
+    # fsync there fails too — including on the rotated-to generation
+    # (drives the declared degraded mode; transient mode exercises the
+    # poison-and-rotate save)
+    STORAGE_CHAOS_FSYNC_PERSIST = False
+    # probability a WAL append fails with ENOSPC (disk full; 0..1)
+    STORAGE_CHAOS_ENOSPC = 0.0
+    # injected fsync latency: base + uniform jitter (slow-disk stall)
+    STORAGE_CHAOS_FSYNC_DELAY_MS = 0.0
+    STORAGE_CHAOS_FSYNC_JITTER_MS = 0.0
+    # probability an append is TORN: only a prefix of the buffer
+    # reaches the file (the crash-consistency shape recovery's
+    # torn-tail check must absorb; 0..1)
+    STORAGE_CHAOS_TORN = 0.0
     # runtime lock witness (gigapaxos_tpu/analysis/witness.py): wrap
     # every declared lock in a recording proxy and cross-check the
     # OBSERVED acquisition DAG against decls.lock_order/leaf_locks —

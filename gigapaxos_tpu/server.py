@@ -153,6 +153,36 @@ def main(argv=None) -> int:
                    help='boot-time partition spec "0,1|2": block both '
                         "directions across the sets (or "
                         "CHAOS_PARTITION=; heal via GET /chaos/heal)")
+    p.add_argument("--storage-chaos-seed", type=int, default=None,
+                   help="storage fault plane PRNG seed (deterministic "
+                        "per (node, segment); or STORAGE_CHAOS_SEED= "
+                        "in the config; runtime control via GET "
+                        "/storage on the stats listener)")
+    p.add_argument("--storage-chaos-fsync-eio", type=float, default=None,
+                   help="probability an fsync fails with EIO "
+                        "(or STORAGE_CHAOS_FSYNC_EIO=)")
+    p.add_argument("--storage-chaos-fsync-persist", action="store_true",
+                   help="make an injected fsync EIO latch: the segment "
+                        "handle stays poisoned so rotation is forced "
+                        "(or STORAGE_CHAOS_FSYNC_PERSIST=)")
+    p.add_argument("--storage-chaos-enospc", type=float, default=None,
+                   help="probability a WAL append fails with ENOSPC "
+                        "(or STORAGE_CHAOS_ENOSPC=)")
+    p.add_argument("--storage-chaos-fsync-delay-ms", type=float,
+                   default=None,
+                   help="added fsync latency in ms (slow-disk "
+                        "emulation; or STORAGE_CHAOS_FSYNC_DELAY_MS=)")
+    p.add_argument("--storage-chaos-fsync-jitter-ms", type=float,
+                   default=None,
+                   help="uniform jitter on top of the fsync delay "
+                        "(or STORAGE_CHAOS_FSYNC_JITTER_MS=)")
+    p.add_argument("--storage-chaos-torn", type=float, default=None,
+                   help="probability an append lands only a prefix "
+                        "(torn write; or STORAGE_CHAOS_TORN=)")
+    p.add_argument("--no-wal-crc", action="store_true",
+                   help="write v1 (un-checksummed) WAL frames instead "
+                        "of the v2 per-record CRC32 format (or "
+                        "WAL_CRC=0; reads auto-detect either way)")
     p.add_argument("--blackbox-mb", type=int, default=None,
                    help="flight-recorder ring byte budget in MB (0 = "
                         "off, the default; or BLACKBOX_MB=); dumps "
@@ -235,6 +265,30 @@ def main(argv=None) -> int:
             else (conv(extras[key.name]) if key.name in extras else None)
         if val is not None:
             Config.set(key, val)
+    # storage fault plane knobs (defaults off; the node mirrors them
+    # into StorageChaos at boot — see chaos/faults.py) + WAL framing
+    for flag, key, conv in (
+            (args.storage_chaos_seed, PC.STORAGE_CHAOS_SEED, int),
+            (args.storage_chaos_fsync_eio,
+             PC.STORAGE_CHAOS_FSYNC_EIO, float),
+            (args.storage_chaos_enospc, PC.STORAGE_CHAOS_ENOSPC, float),
+            (args.storage_chaos_fsync_delay_ms,
+             PC.STORAGE_CHAOS_FSYNC_DELAY_MS, float),
+            (args.storage_chaos_fsync_jitter_ms,
+             PC.STORAGE_CHAOS_FSYNC_JITTER_MS, float),
+            (args.storage_chaos_torn, PC.STORAGE_CHAOS_TORN, float)):
+        val = flag if flag is not None \
+            else (conv(extras[key.name]) if key.name in extras else None)
+        if val is not None:
+            Config.set(key, val)
+    if args.storage_chaos_fsync_persist or \
+            extras.get("STORAGE_CHAOS_FSYNC_PERSIST", "").lower() in \
+            ("1", "true", "yes"):
+        Config.set(PC.STORAGE_CHAOS_FSYNC_PERSIST, True)
+    if args.no_wal_crc:
+        Config.set(PC.WAL_CRC, False)
+    elif "WAL_CRC" in extras:
+        Config.set(PC.WAL_CRC, bool(int(extras["WAL_CRC"])))
     # flight-recorder knobs (defaults off; the node arms its capture
     # ring from these at construction — see gigapaxos_tpu/blackbox/)
     for flag, key, conv in (

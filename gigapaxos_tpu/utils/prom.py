@@ -122,6 +122,10 @@ def render_prometheus(m: dict, prefix: str = "gp") -> str:
             ("parked", "proposals parked awaiting leadership"),
             ("park_dropped", "parked proposals dropped at cap"),
             ("shed", "requests answered retry by the backlog guard"),
+            ("shed_disk", "proposals shed with status 5 while the WAL "
+             "was degraded or the disk full"),
+            ("wal_nacked", "accept votes withdrawn (nacked) because "
+             "the WAL durability barrier failed"),
             ("installs", "coordinator installs won (failover)"),
             ("ballot_changes",
              "ballot/leader churn: new ballots adopted across groups "
@@ -161,6 +165,31 @@ def render_prometheus(m: dict, prefix: str = "gp") -> str:
                  "rewrite (segment lag toward the compact threshold)",
                  [({"segment": str(s.get("segment"))}, s.get("bytes"))
                   for s in segs])
+    health = wal.get("health")
+    if health:
+        w.family(f"{p}_wal_degraded", "gauge",
+                 "1 while the WAL is degraded (fsync failed AND "
+                 "rotation failed: accepts nacked, proposals shed "
+                 "status 5, commits still served) — sticky until "
+                 "restart",
+                 [(None, health.get("degraded"))])
+        w.family(f"{p}_wal_disk_full", "gauge",
+                 "1 while appends are failing with ENOSPC (sheds new "
+                 "proposals, emergency compaction armed)",
+                 [(None, health.get("disk_full"))])
+        w.family(f"{p}_wal_rotations_total", "counter",
+                 "segment handle rotations after a failed fsync or "
+                 "torn append (fsyncgate: a failed fsync poisons its "
+                 "fd forever)",
+                 [(None, health.get("rotations"))])
+        w.family(f"{p}_wal_quarantined_total", "counter",
+                 "WAL segments quarantined at a CRC-mismatching record "
+                 "(replay keeps the verified prefix only)",
+                 [(None, len(health.get("quarantined") or ()))])
+        w.family(f"{p}_wal_ckpt_corrupt_total", "counter",
+                 "checkpoint rows whose stored CRC failed verification "
+                 "(recovery fell back to WAL-only replay)",
+                 [(None, health.get("ckpt_bad"))])
 
     eng = m.get("engine")
     if eng is not None:

@@ -374,6 +374,11 @@ def _chaos_rows():
                       for s in r.get("stages", []))
         if crashes:
             parts.append(f"{crashes} crash/restart stage(s)")
+        sf = r.get("storage_faults", {})
+        parts += [f"{sf[k]} {lbl}" for k, lbl in (
+            ("fsync_eio", "fsync EIO"), ("enospc", "ENOSPC"),
+            ("torn", "torn append(s)"), ("slow_fsync", "slow fsync"))
+            if sf.get(k)]
         out.append(
             f"| Chaos scenario `{r.get('scenario')}` (seed "
             f"{r.get('seed')}, {r.get('backend')} engine, `{name}`) | "

@@ -57,7 +57,7 @@ def _clean_config():
 def _clean_profiler():
     from gigapaxos_tpu.analysis.witness import LockWitness
     from gigapaxos_tpu.blackbox.recorder import BlackboxRecorder
-    from gigapaxos_tpu.chaos.faults import ChaosPlane
+    from gigapaxos_tpu.chaos.faults import ChaosPlane, StorageChaos
     from gigapaxos_tpu.utils.instrument import RequestInstrumenter
     from gigapaxos_tpu.utils.profiler import DelayProfiler
     yield
@@ -80,6 +80,10 @@ def _clean_profiler():
     # and the chaos fault plane (rules, partitions, seed): a failing
     # chaos test must not leave injected faults to poison later tests
     ChaosPlane.reset()
+    # ditto the storage fault plane (fsync/ENOSPC rules, poison
+    # latches) — a leaked persistent-EIO rule would degrade every
+    # later test's WAL
+    StorageChaos.reset()
     # and the flight-recorder registry (PC.BLACKBOX_*): recorders of
     # nodes a test leaked must not receive later dump_all() triggers
     BlackboxRecorder.reset()

@@ -288,7 +288,8 @@ def test_mini_partition_heal_scenario(tmp_path):
     assert row["ok"], row.get("violations")
     assert row["invariants"] == {
         "no_lost_acks": True, "digest_linearizable": True,
-        "cursors_converged": True, "churn_steady": True}
+        "cursors_converged": True, "churn_steady": True,
+        "storage_healthy": True}
     assert row["faults"]["blocked"] > 0  # the partition really bit
     assert row["acked"] > 0
     assert row["schedule_fingerprint"] != "0" * 16
