@@ -865,6 +865,46 @@ def run_bench(args) -> dict:
                 }
             except Exception as exc:  # pragma: no cover
                 e2e["sharded"] = {"error": repr(exc)}
+        # device-mesh scale-up A/B (storm kernel, mesh=1 vs mesh=4):
+        # the XLA device count is fixed before backend init, so each
+        # mesh size runs in its own subprocess — the same worker
+        # `python -m gigapaxos_tpu.parallel` drives.  On a < 4-core
+        # host virtual mesh shards time-slice one core and measure
+        # sharding overhead, not scaling, so the point is skipped WITH
+        # the reason recorded (the artifact must say why the row is
+        # missing, not leave a hole).
+        if cpus >= 4 and not args.quick:
+            try:
+                from gigapaxos_tpu.parallel.__main__ import _run_stage
+                mrows = {}
+                for n in (1, 4):
+                    res = _run_stage(
+                        n, "_bench_worker",
+                        ", waves=12, warmup=2, batch=256, "
+                        "groups_per_dev=128")
+                    if res is None or res.returncode != 0:
+                        raise RuntimeError(
+                            f"mesh={n} stage "
+                            + ("timed out" if res is None
+                               else f"rc={res.returncode}"))
+                    for ln in res.stdout.splitlines():
+                        if ln.startswith("MULTICHIP_ROW "):
+                            mrows[n] = json.loads(
+                                ln[len("MULTICHIP_ROW "):])
+                e2e["mesh"] = {
+                    "mesh_1_dps": mrows[1]["decisions_per_s"],
+                    "mesh_4_dps": mrows[4]["decisions_per_s"],
+                    "speedup": round(
+                        mrows[4]["decisions_per_s"]
+                        / max(mrows[1]["decisions_per_s"], 1e-9), 2),
+                }
+            except Exception as exc:  # pragma: no cover
+                e2e["mesh"] = {"error": repr(exc)}
+        else:
+            e2e["mesh"] = {"skipped": (
+                "quick mode" if cpus >= 4 else
+                f"host has {cpus} core(s) < 4: virtual mesh shards "
+                "time-slice one core — sharding overhead, not scaling")}
     import jax
     info.update(platform=jax.devices()[0].platform,
                 engine_shards=_shards_cfg,

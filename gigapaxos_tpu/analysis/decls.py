@@ -332,6 +332,12 @@ def project_decls() -> Decls:
             # read at node boot into per-node state, torn down with
             # the node; Config.clear() coverage is enough
             "STATS_": None,
+            # engine-shape knobs (ENGINE_SHARDS, ENGINE_MESH): read
+            # once at backend construction into the node's slab/mesh
+            # layout, torn down with the node; the mesh kernel table
+            # itself is memoized per device set (mesh_kernels), which
+            # is config-independent state — Config.clear() is enough
+            "ENGINE_": None,
             # wire-plane knobs (PR 13): read once into the Transport at
             # node boot, torn down with the node — same contract
             "WIRE_": None,

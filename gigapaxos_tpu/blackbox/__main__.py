@@ -3,9 +3,10 @@
 ::
 
     python -m gigapaxos_tpu.blackbox replay <capture.gpbb...> \\
-        [--json-out BLACKBOX_rNN.json] [--workdir DIR] [--keep]
+        [--json-out BLACKBOX_rNN.json] [--workdir DIR] [--keep] \\
+        [--mesh off|auto|N]
     python -m gigapaxos_tpu.blackbox record-demo --out ref.gpbb \\
-        [--requests N] [--groups N] [--shards S]
+        [--requests N] [--groups N] [--shards S] [--mesh off|auto|N]
 
 ``replay`` re-drives each capture through a fresh offline engine and
 prints the per-capture verification report (exit 0 = every capture
@@ -34,7 +35,7 @@ def _cmd_replay(args) -> int:
     for path in args.capture:
         try:
             rep = replay_capture(path, workdir=args.workdir,
-                                 keep=args.keep)
+                                 keep=args.keep, mesh=args.mesh)
         except (CaptureError, OSError) as e:
             print(f"capture  {path}\n  ERROR    {e}", file=sys.stderr)
             reports.append({"file": path, "verdict": "ERROR",
@@ -53,7 +54,7 @@ def _cmd_replay(args) -> int:
 
 
 def record_demo(out: str, n_requests: int = 48, n_groups: int = 4,
-                shards: int = 1) -> str:
+                shards: int = 1, mesh="off") -> str:
     """Drive an offline single-replica node deterministically and dump
     its ring to ``out``.  Same feeding discipline as the live worker:
     one decode batch per wave, self-requeued packets carried forward
@@ -75,7 +76,7 @@ def record_demo(out: str, n_requests: int = 48, n_groups: int = 4,
     tmp = tempfile.mkdtemp(prefix="gpbb-demo-")
     pinned = [(PC.BLACKBOX_MB, 8), (PC.BLACKBOX_S, 0.0),
               (PC.ENGINE_SHARDS, int(shards)), (PC.SYNC_WAL, False),
-              (PC.FUSE_WAVES, "off")]
+              (PC.FUSE_WAVES, "off"), (PC.ENGINE_MESH, mesh)]
     for key, val in pinned:
         Config.set(key, val)
     node = None
@@ -143,7 +144,8 @@ def record_demo(out: str, n_requests: int = 48, n_groups: int = 4,
 
 def _cmd_record_demo(args) -> int:
     out = record_demo(args.out, n_requests=args.requests,
-                      n_groups=args.groups, shards=args.shards)
+                      n_groups=args.groups, shards=args.shards,
+                      mesh=args.mesh)
     print(f"wrote {out}")
     return 0
 
@@ -164,6 +166,10 @@ def main(argv=None) -> int:
                     help="replay scratch dir (default: temp, removed)")
     pr.add_argument("--keep", action="store_true",
                     help="keep the scratch dir")
+    pr.add_argument("--mesh", default=None,
+                    help="override the engine device-mesh for the "
+                    "replay (off/auto/N) — per-wave digests are mesh-"
+                    "independent, so a capture must MATCH either way")
     pr.set_defaults(fn=_cmd_replay)
 
     pd = sub.add_parser("record-demo", help="produce a small "
@@ -172,6 +178,9 @@ def main(argv=None) -> int:
     pd.add_argument("--requests", type=int, default=48)
     pd.add_argument("--groups", type=int, default=4)
     pd.add_argument("--shards", type=int, default=1)
+    pd.add_argument("--mesh", default="off",
+                    help="engine device-mesh while recording "
+                    "(off/auto/N; default off)")
     pd.set_defaults(fn=_cmd_record_demo)
 
     args = p.parse_args(argv)

@@ -73,11 +73,15 @@ def _make_app(name: str):
 
 
 def replay_capture(path: str, workdir: Optional[str] = None,
-                   keep: bool = False) -> dict:
+                   keep: bool = False, mesh=None) -> dict:
     """Re-drive one capture through a fresh offline engine and return
     the verification report dict (see module docstring).  ``workdir``
     holds the replay node's WAL/db (a temp dir by default, removed
-    unless ``keep``)."""
+    unless ``keep``).  ``mesh`` overrides the engine's device-mesh
+    knob for the replay ("off"/"auto"/int N) — the per-wave digests
+    fold host mirrors, so a capture recorded unsharded must replay
+    ``MATCH`` on a mesh-sharded engine and vice versa; this override
+    is how that bit-parity proof is driven (``--mesh`` on the CLI)."""
     records, manifest = read_capture(path)
     if "groups" not in manifest:
         raise CaptureError(
@@ -87,14 +91,14 @@ def replay_capture(path: str, workdir: Optional[str] = None,
     if owns_workdir:
         workdir = tempfile.mkdtemp(prefix="gpbb-replay-")
     try:
-        return _replay_in(path, records, manifest, workdir)
+        return _replay_in(path, records, manifest, workdir, mesh)
     finally:
         if owns_workdir and not keep:
             shutil.rmtree(workdir, ignore_errors=True)
 
 
 def _replay_in(path: str, records: List[dict], manifest: dict,
-               workdir: str) -> dict:
+               workdir: str, mesh=None) -> dict:
     from gigapaxos_tpu.blackbox.recorder import BlackboxRecorder
     from gigapaxos_tpu.paxos.manager import PaxosNode
     from gigapaxos_tpu.paxos.paxosconfig import PC
@@ -114,6 +118,15 @@ def _replay_in(path: str, records: List[dict], manifest: dict,
               (PC.FUSE_WAVES, str(kn.get("fuse_waves", "off"))),
               (PC.SYNC_WAL, False),   # offline: durability is moot
               (PC.BLACKBOX_MB, 0)]    # we arm our own recorder below
+    # device mesh: the caller's override wins (the cross-mesh parity
+    # proof replays an unsharded capture sharded and vice versa); else
+    # the manifest's recorded shape when present — an int there that
+    # exceeds this host's devices degrades to single-device with a
+    # warning (resolve_engine_mesh), which bit-parity makes safe.
+    if mesh is not None:
+        pinned.append((PC.ENGINE_MESH, mesh))
+    elif "engine_mesh" in kn:
+        pinned.append((PC.ENGINE_MESH, kn["engine_mesh"]))
     for key, val in pinned:
         Config.set(key, val)
     node = None

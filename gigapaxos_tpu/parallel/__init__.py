@@ -2,13 +2,16 @@
 
 SURVEY.md §2.7: the reference's scale axis is *groups* (millions of
 independent RSMs) — the data-parallel analog.  Here that axis is sharded
-over TPU cores with ``NamedSharding(mesh, P('groups'))``; XLA inserts the
-ICI collectives implied by cross-shard gathers/scatters.
+over TPU cores with ``NamedSharding(mesh, P('groups'))`` and the per-wave
+kernels run as ``shard_map`` programs that keep every wave shard-local
+(``ops/meshkernels.py``); ``python -m gigapaxos_tpu.parallel`` measures
+decisions/s per mesh size into a ``MULTICHIP_rXX.json`` artifact.
 """
 
 from gigapaxos_tpu.parallel.sharding import (make_group_mesh,
                                              make_sharded_storm,
+                                             resolve_engine_mesh,
                                              shard_fleet, state_sharding)
 
-__all__ = ["make_group_mesh", "make_sharded_storm", "shard_fleet",
-           "state_sharding"]
+__all__ = ["make_group_mesh", "make_sharded_storm",
+           "resolve_engine_mesh", "shard_fleet", "state_sharding"]

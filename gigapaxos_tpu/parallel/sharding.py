@@ -2,34 +2,45 @@
 
 Design (SURVEY.md §2.7, "TPU-native equivalent" column): every per-group
 array (``[G]`` or ``[G, W]``) is sharded on its leading (group) axis; batch
-lanes stay replicated.  Kernel gathers/scatters address *global* row
-indices, so under jit XLA's SPMD partitioner turns them into shard-local
-ops plus the minimal ICI collectives — no hand-written collective calls,
-exactly the pjit recipe (scaling-book style: pick a mesh, annotate
-shardings, let XLA insert collectives).
+lanes stay replicated.  The per-wave kernels run as explicit ``shard_map``
+programs (:mod:`gigapaxos_tpu.ops.meshkernels`): each shard masks the
+batch down to the rows it owns and runs the unmodified kernel body on its
+local block — no cross-device gather/scatter on the hot path, one output
+``psum`` per wave.
+
+One node scales along TWO orthogonal axes, resolved here:
+
+* **lanes** (``PC.ENGINE_SHARDS``, host axis): S worker threads, each
+  owning a ``ColumnarBackend`` slab, a WAL segment, and an engine lock;
+  a group routes to lane ``gkey % S`` (``pkt.shard_split``).
+* **mesh** (``PC.ENGINE_MESH``, device axis): each slab's ``[G, W]``
+  planes shard over D devices; a row lives on device ``row // (G/D)``.
+
+:func:`resolve_engine_mesh` is the single authority for the mesh knob —
+``ColumnarBackend`` calls it at construction, so the storm path, the
+node runtime, and the lane slabs (which may opt in per slab) all resolve
+the device axis identically.
 
 This module is used by BOTH the storm kernel (``make_sharded_storm``,
-the driver dryrun) and the node runtime: ``ColumnarBackend`` auto-shards
-its state over all local devices (``PC.COLUMNAR_MESH = "auto"``), so the
-e2e/failover suites on the virtual 8-CPU mesh run the sharded path end
-to end.  Host-side batch→shard routing (bucket packet lanes by
-``row // rows_per_shard``) is NOT needed for correctness — XLA masks
-out-of-shard lanes — and remains a future throughput optimization for
-real multi-chip topologies.
+the driver dryrun and ``python -m gigapaxos_tpu.parallel``) and the node
+runtime; on the test env's virtual 8-CPU mesh the e2e/failover suites
+run the mesh-sharded path end to end.
 """
 
 from __future__ import annotations
 
+from functools import partial
 from typing import Optional, Tuple
 
 import jax
+import jax.numpy as jnp
 import numpy as np
+from jax.experimental.shard_map import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from gigapaxos_tpu.ops.meshkernels import GROUP_AXIS
 from gigapaxos_tpu.ops.storm import decide_storm_step
 from gigapaxos_tpu.ops.types import ColumnarState
-
-GROUP_AXIS = "groups"
 
 
 def make_group_mesh(n_devices: Optional[int] = None) -> Mesh:
@@ -42,6 +53,41 @@ def make_group_mesh(n_devices: Optional[int] = None) -> Mesh:
                 "(set XLA_FLAGS=--xla_force_host_platform_device_count=N)")
         devs = devs[:n_devices]
     return Mesh(np.asarray(devs), (GROUP_AXIS,))
+
+
+def resolve_engine_mesh(capacity: int, devs=None) -> Optional[Mesh]:
+    """Resolve ``PC.ENGINE_MESH`` into a Mesh (or None = single device).
+
+    ``"off"`` — no mesh.  ``"auto"`` — all of ``devs`` when there are
+    >1 and ``capacity`` divides evenly.  An integer N — the first N of
+    ``devs``; falls back to single-device WITH a warning when the host
+    has fewer devices or capacity doesn't divide (a capture recorded on
+    a bigger mesh must still replay on this box, just unsharded —
+    bit-parity makes that safe).
+    """
+    from gigapaxos_tpu.paxos.paxosconfig import PC
+    from gigapaxos_tpu.utils.config import Config
+
+    knob = str(Config.get(PC.ENGINE_MESH)).strip().lower()
+    if knob == "off":
+        return None
+    if devs is None:
+        devs = jax.local_devices()
+    if knob == "auto":
+        if len(devs) > 1 and capacity % len(devs) == 0:
+            return Mesh(np.asarray(devs), (GROUP_AXIS,))
+        return None
+    n = int(knob)
+    if n <= 1:
+        return None
+    if len(devs) < n or capacity % n:
+        from gigapaxos_tpu.utils.logutil import get_logger
+        get_logger("gp.sharding").warning(
+            "ENGINE_MESH=%d needs %d devices (have %d) and capacity %% "
+            "mesh == 0 (capacity=%d); running single-device",
+            n, n, len(devs), capacity)
+        return None
+    return Mesh(np.asarray(devs[:n]), (GROUP_AXIS,))
 
 
 def state_sharding(mesh: Mesh) -> ColumnarState:
@@ -60,13 +106,24 @@ def shard_fleet(states: Tuple[ColumnarState, ...], mesh: Mesh
 
 
 def make_sharded_storm(mesh: Mesh, n_replicas: int = 3):
-    """The full decide-storm step jitted with explicit shardings: states
-    sharded over ``groups``, batch lanes replicated, outputs sharded the
-    same way (state stays resident; only the decided count is pulled)."""
-    st_sh = tuple(state_sharding(mesh) for _ in range(n_replicas))
-    repl = NamedSharding(mesh, P())
-    return jax.jit(
-        decide_storm_step,
-        in_shardings=(st_sh, repl, repl, repl, repl),
-        out_shardings=(st_sh, repl),
-        donate_argnums=0)
+    """The full decide-storm step as ONE shard_map program: every shard
+    masks the wave down to its own groups (block ownership, same math as
+    :mod:`gigapaxos_tpu.ops.meshkernels`), runs the whole propose ->
+    accept x R -> reply x R -> commit x R pipeline on its local state
+    block, and the only collective is the psum of the decided count.
+    State stays resident and donated; ``n_replicas`` is pinned by the
+    caller and unused here (the fleet tuple's length carries it)."""
+    del n_replicas  # shape comes from the states tuple itself
+
+    @partial(shard_map, mesh=mesh,
+             in_specs=(P(GROUP_AXIS), P(), P(), P(), P()),
+             out_specs=(P(GROUP_AXIS), P()), check_rep=False)
+    def _local(states, g, rlo, rhi, valid):
+        d = jax.lax.axis_index(GROUP_AXIS)
+        gs = states[0].G  # local block: rows per shard
+        mine = valid & (g // gs == d)
+        lg = jnp.where(mine, g - d * gs, 0)
+        states, decided = decide_storm_step(states, lg, rlo, rhi, mine)
+        return states, jax.lax.psum(decided, GROUP_AXIS)
+
+    return jax.jit(_local, donate_argnums=0)

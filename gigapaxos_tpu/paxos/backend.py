@@ -183,6 +183,7 @@ class AcceptorBackend(abc.ABC):
         }
 
     engine_platform = "cpu"  # overridden by device-resident backends
+    engine_mesh = "off"  # device-mesh size when group-axis sharded
 
     def accept_commit(self, rows_a, slots_a, bals_a, reqs_a,
                       rows_c, slots_c, reqs_c
@@ -578,12 +579,14 @@ class ColumnarBackend(AcceptorBackend):
     def __init__(self, capacity: int, window: int = 16,
                  use_pallas_accept: Optional[bool] = None,
                  mesh=None, prof_suffix: str = ""):
-        # mesh: a Mesh object pins sharding; None means "auto" per
-        # PC.COLUMNAR_MESH; the string "off" forces single-device (the
-        # engine-lane slabs use it — lane-level parallelism replaces
-        # mesh parallelism, and S slab meshes would serialize on the
-        # process-wide cpu-mesh dispatch lock).  prof_suffix ("@<k>")
-        # labels this slab's profiler tags with its shard.
+        # mesh: a Mesh object pins sharding; None resolves PC.ENGINE_MESH
+        # ("off"/"auto"/int — parallel.sharding.resolve_engine_mesh is
+        # the single authority); the string "off" forces single-device
+        # (the engine-lane slabs default to it — lane-level parallelism
+        # replaces mesh parallelism on host XLA, and S slab meshes would
+        # serialize on the process-wide cpu-mesh dispatch lock).
+        # prof_suffix ("@<k>") labels this slab's profiler tags with its
+        # shard.
         import jax
 
         from gigapaxos_tpu.ops import kernels, make_state
@@ -603,11 +606,12 @@ class ColumnarBackend(AcceptorBackend):
         self._window = window
         self.capacity = capacity
         # group-axis sharding over a device mesh (SURVEY §2.7): state
-        # lives sharded; batch inputs are replicated; XLA SPMD turns the
-        # row gathers/scatters into shard-local ops + ICI collectives.
-        # "auto" shards across all local devices when there are >1 —
-        # which includes the test env's virtual 8-CPU mesh, so the e2e
-        # suites exercise this path, not just the storm dryrun.
+        # lives sharded; batch inputs are replicated; the kernel table
+        # is swapped for shard_map programs (ops/meshkernels.py) that
+        # run each wave shard-local.  PC.ENGINE_MESH "auto" shards
+        # across all local devices when there are >1 — which includes
+        # the test env's virtual 8-CPU mesh, so the e2e suites exercise
+        # this path, not just the storm dryrun.
         from gigapaxos_tpu.utils.config import Config as _Cfg
         from gigapaxos_tpu.paxos.paxosconfig import PC as _PC
         self._sfx = prof_suffix
@@ -637,21 +641,26 @@ class ColumnarBackend(AcceptorBackend):
                 devs = jax.local_devices()  # no cpu backend: default
         else:
             devs = jax.local_devices()
-        if self._mesh is None and mesh_auto_ok and \
-                str(_Cfg.get(_PC.COLUMNAR_MESH)) == "auto" and \
-                len(devs) > 1 and capacity % len(devs) == 0:
-            from jax.sharding import Mesh
-            self._mesh = Mesh(np.asarray(devs), ("groups",))
+        if self._mesh is None and mesh_auto_ok:
+            from gigapaxos_tpu.parallel.sharding import resolve_engine_mesh
+            self._mesh = resolve_engine_mesh(capacity, devs)
         # resolve the tri-state arg into a local; the parameter itself
         # is never rebound (analysis `shadow` rule)
         pallas_ok = use_pallas_accept
         if self._mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec
+            from gigapaxos_tpu.ops.meshkernels import mesh_kernels
             ns = NamedSharding(self._mesh, PartitionSpec("groups"))
             self.state = jax.device_put(
                 self.state,
                 jax.tree_util.tree_map(lambda _: ns, self.state))
             self._repl = NamedSharding(self._mesh, PartitionSpec())
+            # swap the kernel table: same attribute surface, but every
+            # per-wave entry is a shard_map program (ops/meshkernels.py)
+            # that keeps the wave shard-local — no cross-device gather
+            # on the hot path
+            self._k = mesh_kernels(self._mesh)
+            self.engine_mesh = int(self._mesh.size)
             pallas_ok = False  # Mosaic path is single-device
         elif pinned:
             # single-device pin: host XLA next to a remote accelerator
@@ -1222,15 +1231,18 @@ class ShardedColumnarBackend(AcceptorBackend):
     each slab with local rows, and scatters results back into input
     order; a lane-pure batch (the manager's per-lane workers only ever
     send their own shard's rows) degenerates to one slab call plus an
-    ``arange`` scatter.  Slabs are single-device (mesh "off"): lane
-    parallelism replaces mesh parallelism, and S sharded host-XLA
-    programs would serialize on the process-wide cpu-mesh dispatch
-    lock anyway.  Each slab's profiler tags carry an ``@<shard>``
-    suffix next to the node-wide base tags.
+    ``arange`` scatter.  Slabs default to single-device (mesh "off"):
+    lane parallelism replaces mesh parallelism on host XLA, and S
+    sharded host-XLA programs would serialize on the process-wide
+    cpu-mesh dispatch lock anyway — pass ``mesh=None`` to let each
+    slab resolve ``PC.ENGINE_MESH`` itself (lanes x mesh compose; the
+    two axes are orthogonal, see ``parallel/sharding.py``).  Each
+    slab's profiler tags carry an ``@<shard>`` suffix next to the
+    node-wide base tags.
     """
 
     def __init__(self, capacity: int, window: int = 16, shards: int = 2,
-                 use_pallas_accept: Optional[bool] = None):
+                 use_pallas_accept: Optional[bool] = None, mesh="off"):
         if capacity % shards:
             raise ValueError(
                 f"capacity {capacity} not divisible by shards {shards}")
@@ -1240,9 +1252,10 @@ class ShardedColumnarBackend(AcceptorBackend):
         self.slabs = [
             ColumnarBackend(capacity // shards, window,
                             use_pallas_accept=use_pallas_accept,
-                            mesh="off", prof_suffix=f"@{k}")
+                            mesh=mesh, prof_suffix=f"@{k}")
             for k in range(shards)]
         self.engine_platform = self.slabs[0].engine_platform
+        self.engine_mesh = self.slabs[0].engine_mesh
 
     @property
     def window(self) -> int:

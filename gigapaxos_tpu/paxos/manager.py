@@ -2246,6 +2246,7 @@ class PaxosNode:
                          for k, v in self.addr_map.items()},
             "knobs": {**self._bb_knobs,
                       "engine_shards": self.shards,
+                      "engine_mesh": self.backend.engine_mesh,
                       "fuse_waves": "on" if self._fuse_waves else "off",
                       "sync_wal": self.logger.sync},
             "counters": {"executed": self.n_executed,
@@ -2509,6 +2510,8 @@ class PaxosNode:
                 "groups": len(self.table),
                 "backlog_est": self._backlog_est,
                 "engine_shards": self.shards,
+                # "off" or the device-mesh size (PC.ENGINE_MESH)
+                "engine_mesh": self.backend.engine_mesh,
             },
             # engine overlap split (process-global, like the
             # reference's DelayProfiler): sub = host wall launching

@@ -44,11 +44,17 @@ class PC(ConfigKey):
     # pipeline, byte-for-byte.  Raise toward the host's core count
     # once a single lane saturates (see README "Scaling out a node").
     ENGINE_SHARDS = 1
-    # shard the columnar [G, W] state over the group axis of a device
-    # mesh: "auto" = across all local devices when >1 and capacity
-    # divides evenly (SURVEY §2.7 TP row — the runtime path, not just
-    # the storm kernel); "off" = single device
-    COLUMNAR_MESH = "auto"
+    # device-mesh columnar engine (the group axis of PC.ENGINE_SHARDS'
+    # sibling DEVICE axis): shard the columnar [G, W] state over a
+    # `groups` mesh and run the per-wave kernels as shard_map programs
+    # (ops/meshkernels.py — each shard runs its wave locally, one psum
+    # per output).  "auto" = across all local devices when >1 and
+    # capacity divides evenly (SURVEY §2.7 TP row — the runtime path,
+    # not just the storm kernel); "off" = single device, byte-for-byte
+    # the unsharded pipeline; an integer N = the first N devices
+    # (falls back to single-device with a warning when the host has
+    # fewer).  Replaces the PR-3 COLUMNAR_MESH knob (see MIGRATING).
+    ENGINE_MESH = "auto"
     # which jax backend the NODE RUNTIME's columnar engine runs on:
     # "cpu" (default) pins state + kernels to host XLA — the runtime
     # makes small per-batch calls where per-call host<->device latency

@@ -300,6 +300,7 @@ def render() -> str:
             " — the window knob, not the engine, sets the single-group "
             "ceiling |")
 
+    out.extend(_multichip_rows())
     out.extend(_wire_rows())
     out.extend(_chaos_rows())
     out.extend(_blackbox_rows())
@@ -387,6 +388,36 @@ def _chaos_rows():
             f"{r.get('recovery_s')} s; {r.get('acked')} acked ops, "
             f"{r.get('client_errors')} client timeouts |")
     return out
+
+
+def _multichip_rows():
+    """Mesh-scaling row from the newest tracked ``MULTICHIP_*.json``
+    (`python -m gigapaxos_tpu.parallel`): sharded decide-storm
+    decisions/s per mesh size.  Pre-PR-16 artifacts of this prefix are
+    dryrun smokes (``n_devices``/``ok`` schema) and render as the
+    smoke line they are; the storm-scale schema carries ``rows`` plus
+    a ``scaling_note`` that says whether the host could physically
+    scale (virtual shards on one core time-slice it — that regime is
+    labeled, not passed off as a kernel result)."""
+    files = sorted(glob.glob(os.path.join(HERE, "MULTICHIP_*.json")))
+    if not files:
+        return []
+    name = os.path.basename(files[-1])
+    art = _load(name)
+    if not art:
+        return []
+    if "rows" not in art:  # pre-PR-16 dryrun-smoke schema
+        status = "ok" if art.get("ok") else "FAILED"
+        return [
+            f"| Multi-chip dryrun smoke (`{name}`) | {status} at "
+            f"{art.get('n_devices')} virtual devices |"]
+    cells = ", ".join(
+        f"mesh={r['mesh']}: {_fmt_k(r.get('decisions_per_s'))}/s"
+        for r in art["rows"])
+    return [
+        f"| Device-mesh storm scaling (`{name}`, "
+        f"{art.get('host_cpus')} host core(s)) | {cells} — "
+        f"{art.get('scaling_note')} |"]
 
 
 def _blackbox_rows():

@@ -130,7 +130,7 @@ def test_columnar_backend_pallas_path():
     from gigapaxos_tpu.paxos.paxosconfig import PC
     from gigapaxos_tpu.utils.config import Config
 
-    Config.set(PC.COLUMNAR_MESH, "off")  # Mosaic path is single-device
+    Config.set(PC.ENGINE_MESH, "off")  # Mosaic path is single-device
     G, W, B = 64, 8, 24
     rng = np.random.default_rng(7)
     bks = [ColumnarBackend(G, W, use_pallas_accept=flag)

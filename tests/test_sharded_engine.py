@@ -22,7 +22,7 @@ SH = 4
 
 
 def _mk(cap, W, sharded):
-    Config.set(PC.COLUMNAR_MESH, "off")
+    Config.set(PC.ENGINE_MESH, "off")
     bk = ShardedColumnarBackend(cap, W, shards=SH) if sharded \
         else ColumnarBackend(cap, W)
     rows = np.arange(cap, dtype=np.int32)

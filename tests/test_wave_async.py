@@ -12,7 +12,7 @@ from gigapaxos_tpu.utils.config import Config
 
 
 def _mk_columnar(cap, W, n_active):
-    Config.set(PC.COLUMNAR_MESH, "off")
+    Config.set(PC.ENGINE_MESH, "off")
     bk = ColumnarBackend(cap, W)
     rows = np.arange(n_active, dtype=np.int32)
     bk.create(rows, np.full(n_active, 3, np.int32),
