@@ -406,6 +406,10 @@ def bench_e2e_runtime(n_requests: int = 6000, groups: int = 1000,
             # over the whole run, so every e2e row carries the numbers
             # the wire-aggregation plane moves
             "wire": _wire_rollup(emu),
+            # device-axis rollup (compile/retrace ledger + slab bytes)
+            # so the TPU watcher's probe JSONL can track on-device
+            # compile behavior per capture
+            "engine": _engine_rollup(emu),
             # stage budgets + histogram tails (p50/p99 per update_delay
             # tag) embedded in the artifact of record
             "profiler": DelayProfiler.snapshot(buckets=False),
@@ -413,6 +417,129 @@ def bench_e2e_runtime(n_requests: int = 6000, groups: int = 1000,
     finally:
         emu.stop()
         Config.set(PC.ENGINE_SHARDS, prev_shards)
+        shutil.rmtree(logdir, ignore_errors=True)
+
+
+def _engine_rollup(emu) -> dict:
+    """Device-axis rollup for bench artifacts: the process-wide
+    compile/retrace ledger plus summed per-node slab bytes (None on
+    backends without device slabs, e.g. native)."""
+    from gigapaxos_tpu.testing.main import _engine_rollup as roll
+    return roll(emu)
+
+
+def bench_latency(n_requests: int = 800, groups: int = 64,
+                  concurrency: int = 32, backend: str = "native") -> dict:
+    """The e2e latency baseline artifact (BENCH_LATENCY.json): client
+    request→reply p50/p99 at the latency operating point (depth 32),
+    DECOMPOSED into pipeline stages via the tracing plane.  Every
+    request is force-sampled (PC.TRACE_SAMPLE=1.0), so each reply's
+    req_id joins against the spans of the waves it rode
+    (``RequestInstrumenter.request_spans``): frame decode, engine
+    wave, WAL barrier, reply emit.  Spans are filtered to the
+    request's ENTRY node (its group's coordinator — the critical
+    path); acceptor-side waves overlap it and would double-count.
+    ``queue`` is the residual — client wall minus the attributed
+    stage seconds (socket hops, event-loop wait, batch formation).
+    Stage seconds are still wave-level (a wave serves its whole
+    batch), so the decomposition reads as "where a request's pipeline
+    spent wall time", not an exclusive per-request cost model."""
+    import asyncio
+    import shutil
+    import tempfile
+
+    from gigapaxos_tpu.paxos.client import PaxosClientAsync
+    from gigapaxos_tpu.paxos.paxosconfig import PC
+    from gigapaxos_tpu.testing.harness import PaxosEmulation
+    from gigapaxos_tpu.utils.config import Config
+    from gigapaxos_tpu.utils.instrument import RequestInstrumenter
+
+    prev_sample = float(Config.get(PC.TRACE_SAMPLE))
+    Config.set(PC.TRACE_SAMPLE, 1.0)
+    logdir = tempfile.mkdtemp(prefix="gp_bench_lat_")
+    emu = PaxosEmulation(logdir, n_nodes=3, n_groups=groups,
+                         backend=backend)
+    samples = []  # (client wall seconds, req_id, group index)
+    try:
+        from gigapaxos_tpu.paxos.packets import group_key
+        # entry routing mirrors run_load_fast's entry_shift=0: each
+        # group's requests land on its initial coordinator
+        coords = []
+        for g in emu.groups:
+            mem = emu.members_of(g)
+            coords.append(mem[group_key(g) % len(mem)])
+        emu.run_load_fast(min(500, n_requests), concurrency=concurrency,
+                          client_id=1 << 21)  # warmup (jit + caches)
+
+        async def body():
+            live = sorted(i for i, nd in emu.nodes.items()
+                          if nd is not None)
+            cli = PaxosClientAsync(1 << 23,
+                                   [emu.addr_map[i] for i in live],
+                                   timeout=30.0)
+            sem = asyncio.Semaphore(concurrency)
+
+            async def one(k):
+                async with sem:
+                    t0 = time.perf_counter()
+                    try:
+                        r = await cli.send_request(
+                            emu.groups[k % len(emu.groups)], b"x")
+                    except (TimeoutError, asyncio.TimeoutError):
+                        return
+                    if r.status == 0:
+                        samples.append((time.perf_counter() - t0,
+                                        r.req_id,
+                                        k % len(emu.groups)))
+            await asyncio.gather(*(one(k) for k in range(n_requests)))
+            await cli.close()
+        asyncio.run(body())
+
+        stage_keys = ("decode", "engine", "wal", "emit")
+        cols = {k: [] for k in stage_keys + ("queue", "client")}
+        for total, rid, gi in samples:
+            spans = RequestInstrumenter.request_spans(rid)
+            # wave ids are process-global, so node-less spans (the WAL
+            # barrier logs node=-1) join via the entry node's waves
+            entry_waves = {s["wave"] for s in spans
+                           if s["node"] == coords[gi]}
+            bd = {}
+            for s in spans:
+                if s["node"] == coords[gi] or (
+                        s["node"] == -1 and s["wave"] in entry_waves):
+                    bd[s["kind"]] = bd.get(s["kind"], 0.0) + \
+                        (s["t1"] - s["t0"])
+            attributed = 0.0
+            for k in stage_keys:
+                v = float(bd.get(k, 0.0))
+                cols[k].append(v)
+                attributed += v
+            cols["queue"].append(max(0.0, total - attributed))
+            cols["client"].append(total)
+
+        def pct(xs):
+            if not xs:
+                return {"p50_ms": None, "p99_ms": None, "mean_ms": None}
+            arr = np.asarray(xs)
+            return {"p50_ms": round(1e3 * float(np.percentile(arr, 50)), 3),
+                    "p99_ms": round(1e3 * float(np.percentile(arr, 99)), 3),
+                    "mean_ms": round(1e3 * float(arr.mean()), 3)}
+
+        return {
+            "metric": "client request→reply latency decomposed into "
+                      "pipeline stages (3 replicas, loopback, depth "
+                      f"{concurrency}, every request trace-sampled)",
+            "replicas": 3, "groups": groups, "backend": backend,
+            "concurrency": concurrency,
+            "requests": n_requests, "ok": len(samples),
+            "client": pct(cols["client"]),
+            "stages": {k: pct(cols[k])
+                       for k in ("queue",) + stage_keys},
+            "engine": _engine_rollup(emu),
+        }
+    finally:
+        emu.stop()
+        Config.set(PC.TRACE_SAMPLE, prev_sample)
         shutil.rmtree(logdir, ignore_errors=True)
 
 
@@ -514,6 +641,11 @@ def _parser():
     p.add_argument("--wire-ab", action="store_true",
                    help="A/B the wire-aggregation plane (coalescing "
                         "off vs on) and write BENCH_WIRE.json")
+    p.add_argument("--latency", action="store_true",
+                   help="e2e latency decomposition baseline (client "
+                        "p50/p99 split into queue/decode/engine/wal/"
+                        "emit via the tracing plane); writes "
+                        "BENCH_LATENCY.json")
     return p
 
 
@@ -685,6 +817,19 @@ def main():
                                            time.gmtime())
         path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                             "BENCH_WIRE.json")
+        tmp = path + f".tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(out, f, indent=1)
+        os.replace(tmp, path)
+        print(json.dumps(out))
+        return 0
+    if args.latency:
+        with bench_lock():
+            out = bench_latency(300 if args.quick else 800)
+        out["recorded_at"] = time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                           time.gmtime())
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "BENCH_LATENCY.json")
         tmp = path + f".tmp.{os.getpid()}"
         with open(tmp, "w") as f:
             json.dump(out, f, indent=1)

@@ -213,6 +213,19 @@ def project_decls() -> Decls:
             rlocks=frozenset({"_lock"}),
             guarded={"_layers": "_lock"},
         ),
+        # engine flight deck's compile/retrace ledger: note_trace runs
+        # wherever JAX traces (lane workers, warm-up, the cost-sweep),
+        # jax.monitoring listeners fire on compile threads, and
+        # snapshot()/kernels() run on the stats listener.  `monitoring`
+        # is deliberately undeclared: only the boot path (install)
+        # writes it (the documented single-writer gate exemption).
+        "EngineLedger": ThreadedClass(
+            locks=frozenset({"_lock"}),
+            guarded={a: "_lock" for a in
+                     ("_kernels", "cache_hits", "cache_misses",
+                      "compile_s", "_warmed", "_installed",
+                      "_trigger_fns")},
+        ),
         # flight-recorder capture ring: the note_* hooks run on the
         # intake/lane/logger threads while dump/snapshot run on
         # trigger threads and the stats listener; the class-level
@@ -285,6 +298,10 @@ def project_decls() -> Decls:
         "BlackboxRecorder.note_tick": HotPath("lean"),
         "BlackboxRecorder.note_ingress": HotPath("lean"),
         "BlackboxRecorder._append": HotPath("lean"),
+        # compile-ledger trace hook: only runs while JAX traces a
+        # kernel (never on steady-state dispatch), but it sits inside
+        # every traced function — keep it free of logging/formatting
+        "EngineLedger.note_trace": HotPath("lean"),
     }
     return Decls(
         threaded=threaded,
@@ -312,6 +329,9 @@ def project_decls() -> Decls:
             # those two moved into lock_order above and these O(1)
             # regions became the leaves
             "PaxosLogger._health_lock", "StorageChaos._lock",
+            # the compile-ledger lock protects dict/counter updates
+            # only; trigger callbacks fire AFTER it is released
+            "EngineLedger._lock",
         }),
         indexed_locks={
             "PaxosNode._engine_locks": ("_locks_for",),
@@ -332,12 +352,13 @@ def project_decls() -> Decls:
             # read at node boot into per-node state, torn down with
             # the node; Config.clear() coverage is enough
             "STATS_": None,
-            # engine-shape knobs (ENGINE_SHARDS, ENGINE_MESH): read
-            # once at backend construction into the node's slab/mesh
-            # layout, torn down with the node; the mesh kernel table
-            # itself is memoized per device set (mesh_kernels), which
-            # is config-independent state — Config.clear() is enough
-            "ENGINE_": None,
+            # engine-shape knobs (ENGINE_SHARDS, ENGINE_MESH,
+            # ENGINE_RETRACE_TRIGGER): read once at backend/node
+            # construction, torn down with the node — but the compile/
+            # retrace ledger the family now also covers is a process
+            # singleton whose trigger registrations and warm/retrace
+            # state must not leak across tests
+            "ENGINE_": "EngineLedger.reset",
             # wire-plane knobs (PR 13): read once into the Transport at
             # node boot, torn down with the node — same contract
             "WIRE_": None,
@@ -402,6 +423,10 @@ def project_decls() -> Decls:
                 "slow-fsync delay arithmetic (sleep injection); the "
                 "fault schedule itself is seed-deterministic via the "
                 "per-(node,segment) rng streams",
+            "EngineLedger.*":
+                "compile-ledger wall stamps (last-trace times, compile "
+                "seconds) are observability-plane only; traced kernels "
+                "never read them and digests never see them",
         },
         # -- loopblock --------------------------------------------------
         loopblock_exempt={},

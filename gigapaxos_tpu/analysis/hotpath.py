@@ -86,22 +86,27 @@ def _log_call(n: ast.AST) -> Optional[str]:
     return None
 
 
-def _find_method(sf: SourceFile, cls_name: str, meth: str):
+def _method_index(sf: SourceFile) -> dict:
+    """(class name, method name) -> def node, one AST walk per file.
+    Replaces a full-tree walk per registered hot path — the old
+    hot_paths x files scan dominated the tier-1 analysis budget."""
+    idx: dict = {}
     for node in ast.walk(sf.tree):
-        if isinstance(node, ast.ClassDef) and node.name == cls_name:
+        if isinstance(node, ast.ClassDef):
             for fn in node.body:
-                if isinstance(fn, FUNC_NODES) and fn.name == meth:
-                    return fn
-    return None
+                if isinstance(fn, FUNC_NODES):
+                    idx.setdefault((node.name, fn.name), fn)
+    return idx
 
 
 def check(ctx: Context) -> List[Finding]:
     findings: List[Finding] = []
     seen: Set[str] = set()
+    index = [(sf, _method_index(sf)) for sf in ctx.files]
     for key, hp in sorted(ctx.decls.hot_paths.items()):
         cls_name, meth = key.split(".", 1)
-        for sf in ctx.files:
-            fn = _find_method(sf, cls_name, meth)
+        for sf, idx in index:
+            fn = idx.get((cls_name, meth))
             if fn is None:
                 continue
             seen.add(key)

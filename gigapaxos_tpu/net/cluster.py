@@ -152,6 +152,41 @@ def merge_cluster_stats(per_node: Dict[int, Optional[dict]]) -> dict:
     return out
 
 
+def merge_cluster_engine(per_node: Dict[int, Optional[dict]]) -> dict:
+    """Merge per-node ``/engine`` snapshots (``/cluster/engine``): one
+    fleet view of the device axis.  Ledger counters sum (a retrace
+    anywhere is a retrace), slab bytes and row counts sum, and the
+    capacity headroom is the fleet SUM of per-node estimates (each node
+    hosts distinct groups); per-node detail rides along under
+    ``nodes`` so a skewed member is still attributable."""
+    merged: dict = {}
+    est = 0
+    have_est = False
+    for nid, m in sorted(per_node.items()):
+        if not m:
+            continue
+        for key in ("ledger", "cache", "memory", "balance", "waves"):
+            sub = m.get(key)
+            if isinstance(sub, dict):
+                d = merged.setdefault(key, {})
+                _sum_into(d, sub)
+        mem = m.get("memory") or {}
+        if isinstance(mem.get("max_groups_estimate"), (int, float)):
+            est += int(mem["max_groups_estimate"])
+            have_est = True
+    if have_est:
+        merged.setdefault("memory", {})["max_groups_estimate"] = est
+    elif isinstance(merged.get("memory"), dict):
+        # summed per-node Nones never set the key; make absence explicit
+        merged["memory"].pop("max_groups_estimate", None)
+    return {
+        "cluster": {"nodes": {nid: int(m is not None)
+                              for nid, m in per_node.items()}},
+        **merged,
+        "nodes": {nid: m for nid, m in sorted(per_node.items()) if m},
+    }
+
+
 async def cluster_trace(peers: Dict[int, Tuple[str, int]],
                         trace_id: int, timeout: float = 3.0) -> dict:
     """``/cluster/traces/<id>``: pull every node's trace export and

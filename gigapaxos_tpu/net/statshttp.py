@@ -1,5 +1,6 @@
 """Tiny per-node stats listener: GET /metrics | /stats | /healthz |
-/groups | /groups/<id> | /traces/<trace_id> | /blackbox[/dump].
+/groups | /groups/<id> | /traces/<trace_id> | /blackbox[/dump] |
+/engine | /engine/kernels.
 
 Every server process becomes scrapeable without the full HTTP gateway:
 a dependency-free asyncio HTTP/1.0-style responder living on the node's
@@ -43,7 +44,9 @@ def _json_resp(obj) -> Tuple[str, str, bytes]:
 
 def observability_routes(path: str, groups_fn: Optional[Callable] = None,
                          group_fn: Optional[Callable] = None,
-                         blackbox=None):
+                         blackbox=None,
+                         engine_fn: Optional[Callable] = None,
+                         engine_kernels_fn: Optional[Callable] = None):
     """Shared GET route bodies for the introspection endpoints (the
     per-node listener and the HTTP gateway serve identical content):
 
@@ -55,10 +58,19 @@ def observability_routes(path: str, groups_fn: Optional[Callable] = None,
       (``{"enabled": false}`` when ``PC.BLACKBOX_MB`` is 0)
     - ``/blackbox/dump``      -> snapshot the ring to a ``.gpbb``
       capture now; answers with its path
+    - ``/engine``             -> ``engine_fn()``: the device-axis
+      flight deck (compile/retrace ledger, slab memory accounting,
+      per-shard wave timing / row balance)
+    - ``/engine/kernels``     -> ``engine_kernels_fn()``: per-kernel
+      ledger rows + compiled-HLO cost analysis
 
     Returns ``(status, content_type, body)`` or None (no match).
     """
     path, _, query = path.partition("?")
+    if path == "/engine" and engine_fn is not None:
+        return _json_resp(engine_fn())
+    if path == "/engine/kernels" and engine_kernels_fn is not None:
+        return _json_resp(engine_kernels_fn())
     if path == "/groups" and groups_fn is not None:
         limit = 256
         for part in query.split("&"):

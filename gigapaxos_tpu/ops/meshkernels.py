@@ -39,6 +39,7 @@ from jax.experimental.shard_map import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from gigapaxos_tpu.ops import kernels as _K
+from gigapaxos_tpu.utils.engineledger import EngineLedger
 
 GROUP_AXIS = "groups"
 
@@ -128,36 +129,52 @@ class MeshKernels:
         sh = P(GROUP_AXIS)   # pytree prefix: every state leaf on axis 0
         rp = P()             # batch lanes / outputs: replicated
 
-        def jit1(local, n_in, out_specs):
+        def jit1(name, local, n_in, out_specs):
+            # the ledger wraps the shard_map program (not the local
+            # body): one trace event per (mesh kernel, signature)
             return jax.jit(
-                shard_map(local, mesh=mesh,
-                          in_specs=(sh,) + (rp,) * n_in,
-                          out_specs=out_specs, check_rep=False),
+                EngineLedger.traced(
+                    f"mesh.{name}",
+                    shard_map(local, mesh=mesh,
+                              in_specs=(sh,) + (rp,) * n_in,
+                              out_specs=out_specs, check_rep=False)),
                 donate_argnums=0)
 
         # packed hot entries: (state, [k, B]) -> (state, [j, B])
-        self.propose_p = jit1(_packed1(_K.propose_packed), 1, (sh, rp))
-        self.accept_p = jit1(_packed1(_K.accept_packed), 1, (sh, rp))
+        self.propose_p = jit1(
+            "propose_p", _packed1(_K.propose_packed), 1, (sh, rp))
+        self.accept_p = jit1(
+            "accept_p", _packed1(_K.accept_packed), 1, (sh, rp))
         self.accept_reply_p = jit1(
-            _packed1(_K.accept_reply_packed), 1, (sh, rp))
-        self.commit_p = jit1(_packed1(_K.commit_packed), 1, (sh, rp))
+            "accept_reply_p", _packed1(_K.accept_reply_packed), 1,
+            (sh, rp))
+        self.commit_p = jit1(
+            "commit_p", _packed1(_K.commit_packed), 1, (sh, rp))
         self.propose_accept_self_p = jit1(
+            "propose_accept_self_p",
             _packed1(_K.propose_accept_self_packed), 1, (sh, rp))
         self.accept_reply_commit_self_p = jit1(
+            "accept_reply_commit_self_p",
             _packed1(_K.accept_reply_commit_self_packed), 1, (sh, rp))
         # fused dual-input waves
         self.accept_commit_p = jit1(
-            _packed2(_K.accept_commit_packed), 2, (sh, rp, rp))
+            "accept_commit_p", _packed2(_K.accept_commit_packed), 2,
+            (sh, rp, rp))
         self.request_reply_p = jit1(
-            _packed2(_K.request_reply_packed), 2, (sh, rp, rp))
+            "request_reply_p", _packed2(_K.request_reply_packed), 2,
+            (sh, rp, rp))
         # unpacked cold/control ops
-        self.prepare = jit1(_prepare_local, 3, (sh, rp))
+        self.prepare = jit1("prepare", _prepare_local, 3, (sh, rp))
         self._install = jit1(
+            "install_coordinator",
             _rowcall(_K.install_coordinator_batch), 7, sh)
-        self._create = jit1(_rowcall(_K.create_groups_batch), 6, sh)
-        self._delete = jit1(_rowcall(_K.delete_groups_batch), 2, sh)
-        self._set_cursor = jit1(_rowcall(_K.set_cursor_batch), 4, sh)
-        self._gc = jit1(_rowcall(_K.gc_batch), 3, sh)
+        self._create = jit1(
+            "create_groups", _rowcall(_K.create_groups_batch), 6, sh)
+        self._delete = jit1(
+            "delete_groups", _rowcall(_K.delete_groups_batch), 2, sh)
+        self._set_cursor = jit1(
+            "set_cursor", _rowcall(_K.set_cursor_batch), 4, sh)
+        self._gc = jit1("gc", _rowcall(_K.gc_batch), 3, sh)
 
     # state-only ops keep the module entries' (state, None) return shape
     def install_coordinator(self, state, *args):

@@ -30,6 +30,7 @@ import numpy as np
 
 from gigapaxos_tpu.ops.oracle import OracleGroup, PValue, make_oracle_group
 from gigapaxos_tpu.ops.types import NO_BALLOT, NO_SLOT
+from gigapaxos_tpu.utils.engineledger import EngineLedger
 from gigapaxos_tpu.utils.instrument import RequestInstrumenter
 from gigapaxos_tpu.utils.profiler import DelayProfiler
 
@@ -184,6 +185,22 @@ class AcceptorBackend(abc.ABC):
 
     engine_platform = "cpu"  # overridden by device-resident backends
     engine_mesh = "off"  # device-mesh size when group-axis sharded
+
+    def memory_info(self) -> Optional[dict]:
+        """Slab memory accounting (``GET /engine``): per-plane bytes,
+        bytes/group, and a max-groups capacity estimate.  None for
+        backends without device-resident slabs (scalar/native)."""
+        return None
+
+    def row_ownership(self) -> Optional[dict]:
+        """Active-row counts per engine shard / mesh device (the device
+        axis of the lane-balance view); None when not applicable."""
+        return None
+
+    def kernel_costs(self) -> Dict[str, dict]:
+        """Compiled-HLO cost analysis (flops / bytes accessed) per hot
+        kernel; empty for non-jit backends."""
+        return {}
 
     def accept_commit(self, rows_a, slots_a, bals_a, reqs_a,
                       rows_c, slots_c, reqs_c
@@ -542,6 +559,11 @@ class EngineWave:
         res = self._finish()
         RequestInstrumenter.span_end(sp)
         DelayProfiler.update_total("eng.collect", t0, self._n)
+        # full wave wall (submit->materialized) as a histogram, per
+        # shard when this slab is one lane of a sharded engine — the
+        # per-shard wave-time distribution the flight deck renders
+        DelayProfiler.update_delay("eng.wave" + self._sfx,
+                                   self._submitted)
         if self._sfx:
             DelayProfiler.update_total("eng.collect" + self._sfx, t0,
                                        self._n)
@@ -700,6 +722,7 @@ class ColumnarBackend(AcceptorBackend):
                 from gigapaxos_tpu.utils.logutil import get_logger
                 get_logger("gp.backend").exception(
                     "pallas accept unavailable; using XLA scatter path")
+        self._kcosts: Optional[Dict[str, dict]] = None
         self._warm_kernels()
 
     def _warm_kernels(self) -> None:
@@ -717,16 +740,22 @@ class ColumnarBackend(AcceptorBackend):
         def z(rows_):
             return self._dev(np.zeros((rows_, b), np.int32))
 
-        st = self.state
-        st, _ = k.propose_p(st, z(4))
-        st, _ = k.accept_p(st, z(6))
-        st, _ = k.accept_reply_p(st, z(6))
-        st, _ = k.commit_p(st, z(5))
-        st, _ = k.propose_accept_self_p(st, z(5))
-        st, _ = k.accept_reply_commit_self_p(st, z(6))
-        st, _, _ = k.accept_commit_p(st, z(6), z(5))
-        st, _, _ = k.request_reply_p(st, z(5), z(6))
-        self.state = st
+        # the warming bracket tells the ledger these traces define the
+        # hot set (and are never retrace incidents); mark_warm arms the
+        # alarm — any later re-trace of a kernel warmed here fires the
+        # flight recorder
+        with EngineLedger.warming():
+            st = self.state
+            st, _ = k.propose_p(st, z(4))
+            st, _ = k.accept_p(st, z(6))
+            st, _ = k.accept_reply_p(st, z(6))
+            st, _ = k.commit_p(st, z(5))
+            st, _ = k.propose_accept_self_p(st, z(5))
+            st, _ = k.accept_reply_commit_self_p(st, z(6))
+            st, _, _ = k.accept_commit_p(st, z(6), z(5))
+            st, _, _ = k.request_reply_p(st, z(5), z(6))
+            self.state = st
+        EngineLedger.mark_warm()
 
     @property
     def window(self) -> int:
@@ -1179,6 +1208,117 @@ class ColumnarBackend(AcceptorBackend):
                 self.state, self._dev(np.asarray([row], np.int32)),
                 row_state, self._dev(np.asarray([True])))
 
+    # -- flight deck: slab accounting + kernel costs -----------------------
+
+    def memory_info(self) -> dict:
+        """Per-plane slab bytes from the ACTUAL device arrays (leaf
+        ``.nbytes``, not the analytical ``state_nbytes`` estimate),
+        bytes/group, and — when the runtime exposes
+        ``device.memory_stats()`` — a max-groups-at-current-config
+        capacity estimate cross-checked against the device's byte
+        limit.  Cold path (introspection scrapes only)."""
+        st = self.state
+        planes: Dict[str, int] = {}
+        total = 0
+        for f in st._fields:
+            nb = int(getattr(st, f).nbytes)
+            plane = _PLANE_OF.get(f, "control")
+            planes[plane] = planes.get(plane, 0) + nb
+            total += nb
+        per_group = total / float(self.capacity)
+        out: dict = {
+            "planes": planes,
+            "total_bytes": total,
+            "capacity": self.capacity,
+            "window": self._window,
+            "bytes_per_group": per_group,
+            "mesh": int(self._mesh.size) if self._mesh is not None
+            else 1,
+            "platform": self.engine_platform,
+        }
+        try:
+            dev = next(iter(st.bal.devices()))
+            ms = dev.memory_stats()
+        except Exception:
+            ms = None
+        if ms:
+            limit = int(ms.get("bytes_limit", 0) or 0)
+            out["device_bytes_in_use"] = int(
+                ms.get("bytes_in_use", 0) or 0)
+            out["device_bytes_limit"] = limit
+            if limit and per_group:
+                # each mesh device holds capacity/mesh rows, so the
+                # fleet capacity is per-device headroom x mesh size
+                # (10% reserved for batch buffers + workspace)
+                out["max_groups_estimate"] = int(
+                    0.9 * limit / per_group) * out["mesh"]
+        return out
+
+    def row_ownership(self) -> dict:
+        """Active-row count per mesh device (contiguous G/D blocks —
+        the layout ``P(GROUP_AXIS)`` produces).  One bool-plane
+        transfer; cold path."""
+        active = np.asarray(self.state.active)
+        d = int(self._mesh.size) if self._mesh is not None else 1
+        gs = self.capacity // d
+        return {
+            "rows_active": int(active.sum()),
+            "mesh": [int(active[k * gs:(k + 1) * gs].sum())
+                     for k in range(d)],
+        }
+
+    def kernel_costs(self) -> Dict[str, dict]:
+        """flops / bytes-accessed per hot kernel from the lowered HLO's
+        ``cost_analysis()`` at the warm (bucket-8) shapes.  Lowering
+        re-traces, so the whole sweep runs inside the ledger's warming
+        bracket — a cost scrape must never read as a retrace incident.
+        Memoized per backend; best-effort per kernel (a backend whose
+        lowering can't cost-analyze reports nulls, not errors)."""
+        if self._kcosts is not None:
+            return self._kcosts
+        k, b = self._k, _bucket(0)
+
+        def z(rows_):
+            return self._dev(np.zeros((rows_, b), np.int32))
+
+        prefix = "mesh." if self._mesh is not None else ""
+        sweep = [("propose_p", (z(4),)), ("accept_p", (z(6),)),
+                 ("accept_reply_p", (z(6),)), ("commit_p", (z(5),)),
+                 ("accept_commit_p", (z(6), z(5))),
+                 ("request_reply_p", (z(5), z(6)))]
+        out: Dict[str, dict] = {}
+        with EngineLedger.warming():
+            for name, args in sweep:
+                try:
+                    ca = getattr(k, name).lower(
+                        self.state, *args).cost_analysis()
+                    if isinstance(ca, (list, tuple)):
+                        ca = ca[0]
+                    out[prefix + name] = {
+                        "flops": float(ca.get("flops", 0.0)),
+                        "bytes_accessed": float(
+                            ca.get("bytes accessed", 0.0)),
+                    }
+                except Exception:
+                    out[prefix + name] = {"flops": None,
+                                          "bytes_accessed": None}
+        self._kcosts = out
+        return out
+
+
+# plane grouping of the ColumnarState fields for the accounting view:
+# the three [G, W, k] slabs stay individually visible; the [G] scalar
+# mirrors roll up by role
+_PLANE_OF = {
+    "acc": "acc", "dec": "dec", "prop": "prop",
+    "bal": "ballots", "cbal": "ballots",
+    "exec_cursor": "cursors", "next_slot": "cursors",
+    "gc_slot": "cursors",
+    "prep_votes": "votes",
+    "active": "control", "members": "control", "version": "control",
+    "is_coord": "control", "coord_active": "control",
+}
+
 
 # --------------------------------------------------------------------------
 # sharded columnar backend (row-partitioned engine lanes)
@@ -1554,3 +1694,48 @@ class ShardedColumnarBackend(AcceptorBackend):
     def restore_row(self, row: int, snap: dict) -> None:
         self.slabs[row % self.shards].restore_row(row // self.shards,
                                                   snap)
+
+    # -- flight deck: aggregate the slabs ---------------------------------
+
+    def memory_info(self) -> dict:
+        """Sum of the slabs' accounting, with a per-shard breakdown —
+        ``bytes_per_group`` stays the whole-engine ratio (total bytes /
+        global capacity), so the capacity math is shard-invariant."""
+        per = [s.memory_info() for s in self.slabs]
+        planes: Dict[str, int] = {}
+        for p in per:
+            for name, nb in p["planes"].items():
+                planes[name] = planes.get(name, 0) + nb
+        total = sum(p["total_bytes"] for p in per)
+        out: dict = {
+            "planes": planes,
+            "total_bytes": total,
+            "capacity": self.capacity,
+            "window": self._window,
+            "bytes_per_group": total / float(self.capacity),
+            "mesh": per[0]["mesh"],
+            "platform": self.engine_platform,
+            "engine_shards": self.shards,
+            "per_shard": [{"total_bytes": p["total_bytes"],
+                           "capacity": p["capacity"]} for p in per],
+        }
+        ests = [p["max_groups_estimate"] for p in per
+                if "max_groups_estimate" in p]
+        if ests:
+            # slabs share the device pool: the fleet fits what the
+            # tightest slab extrapolates to, times the shard count
+            out["max_groups_estimate"] = min(ests) * self.shards
+        return out
+
+    def row_ownership(self) -> dict:
+        per = [s.row_ownership() for s in self.slabs]
+        return {
+            "rows_active": sum(p["rows_active"] for p in per),
+            "shards": [p["rows_active"] for p in per],
+            "mesh": per[0]["mesh"],
+        }
+
+    def kernel_costs(self) -> Dict[str, dict]:
+        # slabs share one jit cache (same shapes/mesh): slab 0 speaks
+        # for all of them
+        return self.slabs[0].kernel_costs()

@@ -53,7 +53,9 @@ from gigapaxos_tpu.paxos.logger import (CheckpointRec, LogEntry, PaxosLogger,
                                         WalDegradedError, WalImpairedError)
 from gigapaxos_tpu.paxos.paxosconfig import PC
 from gigapaxos_tpu.utils.config import Config
+from gigapaxos_tpu.utils.engineledger import EngineLedger
 from gigapaxos_tpu.utils.instrument import RequestInstrumenter
+from gigapaxos_tpu.utils.jaxcache import cache_metrics as _cache_metrics
 from gigapaxos_tpu.utils.logutil import get_logger
 from gigapaxos_tpu.utils.profiler import DelayProfiler
 
@@ -565,6 +567,12 @@ class PaxosNode:
                 manifest_fn=self._blackbox_manifest)
         self.transport.blackbox = self.blackbox
         self.logger.blackbox = self.blackbox
+        # retrace alarm (PR 18): a hot-path kernel re-tracing after
+        # warm-up dumps the flight recorder — a mid-storm recompile is
+        # an incident, not noise.  Deregistered in stop().
+        if self.blackbox is not None and \
+                bool(Config.get(PC.ENGINE_RETRACE_TRIGGER)):
+            EngineLedger.add_trigger(self.blackbox.trigger)
         self._loop_thread: Optional[threading.Thread] = None
         self._worker_thread: Optional[threading.Thread] = None
         self._loop = None
@@ -826,6 +834,7 @@ class PaxosNode:
         if self.blackbox is not None:
             # deregister from the live set: a stopped node must not
             # receive later dump_all() triggers (its engine is gone)
+            EngineLedger.remove_trigger(self.blackbox.trigger)
             self.blackbox.close()
         self.logger.close(discard=abort)
 
@@ -2517,11 +2526,20 @@ class PaxosNode:
             # reference's DelayProfiler): sub = host wall launching
             # waves, blk = wall blocked materializing device results,
             # ovl = submit->collect gap the host spent on other work
-            # while the device ran
+            # while the device ran.  The flight-deck sub-dicts (PR 18):
+            # ledger = compile/retrace counts, cache = persistent-cache
+            # hit/miss — both O(kernels) dict copies, cheap enough for
+            # every scrape
             "engine": {
                 "submit_s": s("eng.submit"),
                 "collect_s": s("eng.collect"),
                 "overlap_s": s("eng.overlap"),
+                # per-kernel rows replace the snapshot's count so the
+                # prometheus render can label gp_engine_compiles_total
+                # by kernel; /engine keeps the scalar summary
+                "ledger": {**EngineLedger.snapshot(),
+                           "kernels": EngineLedger.kernels()},
+                "cache": _cache_metrics(),
             },
             "net": self.transport.metrics(),
         }
@@ -2550,10 +2568,78 @@ class PaxosNode:
                           "health": self.logger.wal_health()}
             out["profiler"] = DelayProfiler.snapshot()
             out["spans"] = RequestInstrumenter.span_stats()
+            # slab accounting + mesh/shard row balance: one bool-plane
+            # transfer under the engine locks, gated with the heavy
+            # view for the same reason as the health scan
+            mem, bal = self._engine_detail()
+            if mem is not None:
+                out["engine"]["memory"] = mem
+            if bal is not None:
+                out["engine"]["balance"] = bal
             slow = RequestInstrumenter.slow_traces()
             if slow:
                 out["slow_traces"] = slow
         return out
+
+    def _engine_detail(self):
+        """``(memory_info, row_ownership)`` under ALL engine locks —
+        the columnar engine swaps donated state buffers per wave, so an
+        unlocked read can observe a deleted buffer (same contract as
+        :meth:`_inspect_locked`).  ``(None, None)`` for backends
+        without device slabs."""
+        if self.backend.memory_info.__func__ is \
+                AcceptorBackend.memory_info:
+            return None, None
+        with contextlib.ExitStack() as stack:
+            for lk in self._locks_for(range(self.shards)):
+                stack.enter_context(lk)
+            return (self.backend.memory_info(),
+                    self.backend.row_ownership())
+
+    def engine_info(self) -> dict:
+        """``GET /engine``: the device-axis flight deck — compile/
+        retrace ledger, persistent-cache hit/miss, slab memory math,
+        and per-shard wave timing / row balance."""
+        t = DelayProfiler.totals()
+
+        def s(tag):
+            return t.get(tag, (0.0,))[0]
+
+        per_shard = {}
+        for k in range(self.shards):
+            sub = s(f"eng.submit@{k}")
+            col = s(f"eng.collect@{k}")
+            if sub or col:
+                per_shard[k] = {"submit_s": sub, "collect_s": col,
+                                "overlap_s": s(f"eng.overlap@{k}")}
+        mem, bal = self._engine_detail()
+        return {
+            "node": self.id,
+            "platform": self.backend.engine_platform,
+            "engine_shards": self.shards,
+            "engine_mesh": self.backend.engine_mesh,
+            "ledger": EngineLedger.snapshot(),
+            "cache": _cache_metrics(),
+            "memory": mem,
+            "balance": bal,
+            "waves": {"submit_s": s("eng.submit"),
+                      "collect_s": s("eng.collect"),
+                      "overlap_s": s("eng.overlap"),
+                      "per_shard": per_shard},
+        }
+
+    def engine_kernels(self) -> dict:
+        """``GET /engine/kernels``: per-kernel ledger rows (compiles /
+        retraces / compile seconds) joined with the compiled-HLO cost
+        analysis (flops, bytes accessed).  The cost sweep lowers under
+        the engine locks — it reads the live state refs."""
+        with contextlib.ExitStack() as stack:
+            for lk in self._locks_for(range(self.shards)):
+                stack.enter_context(lk)
+            costs = self.backend.kernel_costs()
+        return {"node": self.id,
+                "kernels": EngineLedger.kernels(),
+                "costs": costs}
 
     def _groups_health(self) -> dict:
         """Node-wide consensus-health rollup from the host mirrors
@@ -2653,7 +2739,9 @@ class PaxosNode:
         from gigapaxos_tpu.net.statshttp import observability_routes
         return observability_routes(path, groups_fn=self.groups_info,
                                     group_fn=self.group_info,
-                                    blackbox=self.blackbox)
+                                    blackbox=self.blackbox,
+                                    engine_fn=self.engine_info,
+                                    engine_kernels_fn=self.engine_kernels)
 
     def stats(self) -> str:
         """One-line node counters (ref: the reference's periodic

@@ -193,6 +193,15 @@ class PC(ConfigKey):
     # auto-dump when a sampled request enters the slow-request log
     # (requires SLOW_TRACE_S > 0 and the trace plane enabled)
     BLACKBOX_ON_SLOW = False
+    # engine flight deck: register the flight recorder as a retrace
+    # alarm — when a warmed hot-path kernel re-traces (silent multi-
+    # second stall symptom), the EngineLedger fires a blackbox trigger
+    # ("engine_retrace:<kernel>") so the ring is dumped with the frames
+    # that caused the shape excursion still in it.  Needs BLACKBOX_MB>0
+    # to actually dump; the ledger itself is always on (trace-time only,
+    # zero steady-state dispatch cost).  1 = arm, 0 = ledger counts but
+    # never triggers.
+    ENGINE_RETRACE_TRIGGER = 1
     # wire-plane aggregation (HT-Paxos-style per-peer
     # coalescing, arXiv:1407.1237).  WIRE_COALESCE packs every frame a
     # worker batch emits toward one peer into a single FRAG super-frame

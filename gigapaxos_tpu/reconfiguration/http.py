@@ -22,6 +22,10 @@ Routes::
     GET  /traces/ID         this process's share of one sampled trace
     GET  /blackbox[/dump]   co-located node's flight-recorder state /
                             snapshot its ring to a .gpbb capture
+    GET  /engine            co-located node's device-axis flight deck
+                            (compile/retrace ledger, slab memory
+                            accounting, per-shard wave timing)
+    GET  /engine/kernels    per-kernel ledger rows + HLO cost analysis
     GET  /cluster/metrics   ONE scrape point for the deployment: fan
                             out to every PC.STATS_PEERS node's /stats,
                             merge (histograms bucket-wise), render
@@ -29,6 +33,8 @@ Routes::
     GET  /cluster/traces/ID cross-node stitched trace breakdown
     GET  /cluster/blackbox[/dump]  flight-recorder fan-out: one call
                             snapshots (or dumps) every node's ring
+    GET  /cluster/engine    device-axis fan-out: every node's /engine
+                            merged (counters summed, capacity totalled)
 
 Run standalone::
 
@@ -180,7 +186,8 @@ class HttpFrontend:
                     path, self.metrics_source or process_metrics)
             if method == "GET" and (path.startswith("/groups")
                                     or path.startswith("/traces/")
-                                    or path.startswith("/blackbox")):
+                                    or path.startswith("/blackbox")
+                                    or path.startswith("/engine")):
                 from gigapaxos_tpu.net.statshttp import \
                     observability_routes
                 node = self.obs_node
@@ -188,7 +195,10 @@ class HttpFrontend:
                     path,
                     groups_fn=node.groups_info if node else None,
                     group_fn=node.group_info if node else None,
-                    blackbox=getattr(node, "blackbox", None))
+                    blackbox=getattr(node, "blackbox", None),
+                    engine_fn=getattr(node, "engine_info", None),
+                    engine_kernels_fn=getattr(node, "engine_kernels",
+                                              None))
                 if resp is not None:
                     return resp
             if method == "GET" and path.startswith("/cluster/"):
@@ -249,6 +259,7 @@ class HttpFrontend:
         merge degenerates to an empty roster (the local process view
         stays on /metrics — /cluster/* answers for the fleet only)."""
         from gigapaxos_tpu.net.cluster import (cluster_trace,
+                                               merge_cluster_engine,
                                                merge_cluster_stats,
                                                scrape_cluster)
         from gigapaxos_tpu.net.statshttp import parse_trace_id
@@ -269,6 +280,13 @@ class HttpFrontend:
             out = await cluster_trace(self.stats_peers, tid)
             return ("200 OK", "application/json",
                     json.dumps(out, default=str).encode())
+        if path == "/cluster/engine":
+            # device-axis fan-out: every node's compile/retrace ledger,
+            # slab accounting and wave timing merged into a fleet view
+            per_node = await scrape_cluster(self.stats_peers, "/engine")
+            return ("200 OK", "application/json",
+                    json.dumps(merge_cluster_engine(per_node),
+                               default=str).encode())
         if path in ("/cluster/blackbox", "/cluster/blackbox/dump"):
             # flight-recorder fan-out: one call snapshots (or dumps)
             # every node's ring — a coherent cross-node incident

@@ -56,6 +56,24 @@ def enable_persistent_cache(dirpath: str | None = None) -> bool:
                           0.0)
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
         _enabled = dirpath or CACHE_DIR
+        # arm the ledger's jax.monitoring listeners now so the very
+        # first compile's cache_hits/cache_misses events are counted
+        from gigapaxos_tpu.utils.engineledger import EngineLedger
+        EngineLedger.install()
         return True
     except Exception:
         return False
+
+
+def cache_metrics() -> dict:
+    """Live cache telemetry for ``metrics()`` / ``GET /engine``.  A
+    cold-but-active cache now reads as ``active`` with ``misses > 0``,
+    which is distinguishable from a disabled one (``active`` False,
+    both counters frozen at whatever the in-memory plane saw)."""
+    from gigapaxos_tpu.utils.engineledger import EngineLedger
+    return {
+        "active": bool(_enabled),
+        "dir": _enabled,
+        "hits": EngineLedger.cache_hits,
+        "misses": EngineLedger.cache_misses,
+    }

@@ -201,6 +201,84 @@ def render_prometheus(m: dict, prefix: str = "gp") -> str:
             [({"phase": "sub"}, eng.get("submit_s", 0.0)),
              ({"phase": "blk"}, eng.get("collect_s", 0.0)),
              ({"phase": "ovl"}, eng.get("overlap_s", 0.0))])
+        ledger = eng.get("ledger") or {}
+        kernels = ledger.get("kernels") or {}
+        if isinstance(kernels, dict) and kernels:
+            w.family(f"{p}_engine_compiles_total", "counter",
+                     "XLA traces/compiles per engine kernel (one per "
+                     "shape-bucket signature when the ladder works)",
+                     [({"kernel": k}, v.get("compiles"))
+                      for k, v in sorted(kernels.items())
+                      if isinstance(v, dict)])
+            w.family(f"{p}_engine_retraces_total", "counter",
+                     "post-warmup re-traces of hot-path kernels (each "
+                     "one is a silent multi-second stall; also fires "
+                     "a flight-recorder trigger)",
+                     [({"kernel": k}, v.get("retraces"))
+                      for k, v in sorted(kernels.items())
+                      if isinstance(v, dict)])
+        if isinstance(ledger, dict) and ledger:
+            w.family(f"{p}_engine_compile_seconds_total", "counter",
+                     "wall seconds spent in XLA backend compilation "
+                     "(jax.monitoring; 0 when unavailable)",
+                     [(None, ledger.get("compile_s"))])
+        cache = eng.get("cache")
+        if isinstance(cache, dict) and cache:
+            w.family(f"{p}_engine_cache_active", "gauge",
+                     "1 when the persistent XLA compilation cache is "
+                     "armed (utils/jaxcache.py)",
+                     [(None, bool(cache.get("active")))])
+            w.family(f"{p}_engine_cache_hits_total", "counter",
+                     "persistent compilation cache hits",
+                     [(None, cache.get("hits"))])
+            w.family(f"{p}_engine_cache_misses_total", "counter",
+                     "persistent compilation cache misses (cold "
+                     "compiles paid in full)",
+                     [(None, cache.get("misses"))])
+        mem = eng.get("memory")
+        if isinstance(mem, dict) and mem:
+            planes = mem.get("planes") or {}
+            w.family(f"{p}_engine_slab_bytes", "gauge",
+                     "resident device slab bytes per state plane "
+                     "(acc/dec/prop slabs, ballots, cursors, votes, "
+                     "control mirrors)",
+                     [({"plane": k}, v)
+                      for k, v in sorted(planes.items())])
+            w.family(f"{p}_engine_slab_bytes_total", "gauge",
+                     "total resident device slab bytes",
+                     [(None, mem.get("total_bytes"))])
+            w.family(f"{p}_engine_bytes_per_group", "gauge",
+                     "slab bytes per group row (total/capacity)",
+                     [(None, mem.get("bytes_per_group"))])
+            w.family(f"{p}_engine_capacity_rows", "gauge",
+                     "allocated group-row capacity across slabs",
+                     [(None, mem.get("capacity"))])
+            w.family(f"{p}_engine_device_bytes", "gauge",
+                     "device allocator view (device.memory_stats): "
+                     "kind=in_use live allocations, kind=limit pool "
+                     "ceiling (absent on backends without stats)",
+                     [({"kind": "in_use"}, mem.get("device_bytes_in_use")),
+                      ({"kind": "limit"}, mem.get("device_bytes_limit"))])
+            w.family(f"{p}_engine_max_groups_estimate", "gauge",
+                     "estimated group capacity at 90% of the device "
+                     "limit, scaled by the mesh (absent without "
+                     "memory_stats)",
+                     [(None, mem.get("max_groups_estimate"))])
+        bal = eng.get("balance")
+        if isinstance(bal, dict) and bal:
+            w.family(f"{p}_engine_rows_active", "gauge",
+                     "active (live-group) rows resident on the engine",
+                     [(None, bal.get("rows_active"))])
+            w.family(f"{p}_engine_shard_rows_active", "gauge",
+                     "active rows per engine shard (round-robin row "
+                     "ownership balance)",
+                     [({"shard": str(i)}, v)
+                      for i, v in enumerate(bal.get("shards") or [])])
+            w.family(f"{p}_engine_mesh_rows_active", "gauge",
+                     "active rows per mesh device block (group-space "
+                     "sharding balance)",
+                     [({"device": str(i)}, v)
+                      for i, v in enumerate(bal.get("mesh") or [])])
 
     net = m.get("net", {})
     for key, name, help_ in (

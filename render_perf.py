@@ -302,6 +302,7 @@ def render() -> str:
 
     out.extend(_multichip_rows())
     out.extend(_wire_rows())
+    out.extend(_latency_rows())
     out.extend(_chaos_rows())
     out.extend(_blackbox_rows())
     out.extend(_analysis_rows())
@@ -336,6 +337,35 @@ def _wire_rows():
         f"(**{art.get('syscalls_per_decision_ratio')}×**); "
         f"{onw.get('tx_frag_members')} frames coalesced into "
         f"{onw.get('tx_frags')} super-frames; recorded "
+        f"{art.get('recorded_at')} |"]
+
+
+def _latency_rows():
+    """Latency-decomposition row from the tracked ``BENCH_LATENCY.json``
+    (`python bench.py --latency`): client request→reply p50/p99 at the
+    depth-32 latency point, split into queue / decode / engine / WAL /
+    emit via the tracing plane (every request force-sampled, spans
+    filtered to the request's coordinator node)."""
+    art = _load("BENCH_LATENCY.json")
+    if not art or "stages" not in art:
+        return []
+    cl = art.get("client", {})
+    st = art["stages"]
+
+    def cell(key):
+        s = st.get(key) or {}
+        return (f"{key} {s.get('p50_ms', '?')}/"
+                f"{s.get('p99_ms', '?')}")
+    cells = ", ".join(cell(k) for k in
+                      ("queue", "decode", "engine", "wal", "emit"))
+    return [
+        "| E2E latency decomposition (client p50/p99 ms by pipeline "
+        f"stage; {art.get('replicas')} replicas, {art.get('groups')} "
+        f"groups, depth {art.get('concurrency')}, "
+        "`BENCH_LATENCY.json`) | "
+        f"client **{cl.get('p50_ms')} / {cl.get('p99_ms')} ms**; "
+        f"stage p50/p99: {cells} — every request trace-sampled, "
+        "coordinator-node spans; recorded "
         f"{art.get('recorded_at')} |"]
 
 

@@ -87,3 +87,8 @@ def _clean_profiler():
     # and the flight-recorder registry (PC.BLACKBOX_*): recorders of
     # nodes a test leaked must not receive later dump_all() triggers
     BlackboxRecorder.reset()
+    # and the compile/retrace ledger (ENGINE_ family): trigger
+    # registrations and per-test retrace counts must not leak (compile
+    # counts and hot flags persist deliberately — jit caches do too)
+    from gigapaxos_tpu.utils.engineledger import EngineLedger
+    EngineLedger.reset()

@@ -165,6 +165,15 @@ def capture_ondevice(timeout_s: int = 900) -> dict:
                     "ballot_changes", 0)
                 rec["ondevice_exec_lag_max"] = health.get(
                     "exec_lag_max", 0)
+            # device-axis ledger (engine flight deck): a capture where
+            # the hot kernels re-traced mid-run compiled DURING the
+            # measurement — its numbers are labeled, not trusted
+            eng = info.get("engine", {})
+            if eng:
+                rec["ondevice_compiles"] = eng.get("compiles", 0)
+                rec["ondevice_retraces"] = eng.get("retraces", 0)
+                if eng.get("slab_bytes_total") is not None:
+                    rec["ondevice_slab_bytes"] = eng["slab_bytes_total"]
             return rec
         return {"ondevice": "rc_%d" % res.returncode,
                 "ondevice_wall_s": round(time.time() - t0, 1)}
